@@ -1,0 +1,49 @@
+"""Server-side aggregator protocol.
+
+Reference shape: each algorithm package has an ``<Algo>Aggregator`` class
+holding mutable server state and an ``aggregate()`` method looping over
+client state_dicts key by key (e.g. fedml_api/distributed/fedavg/
+FedAVGAggregator.py:59-88). Here an aggregator is a pair of pure functions
+over *stacked* client pytrees (leading client axis) — aggregation is one
+weighted reduction XLA lowers to a psum over the mesh's client axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core import tree as treelib
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregator:
+    """``init_state(global_variables) -> state`` and
+    ``aggregate(global, stacked_locals, weights, state, rng)
+    -> (new_global, new_state, metrics)``.
+
+    ``stacked_locals`` leaves have shape [C, ...]; ``weights`` is [C]
+    (per-client sample counts — the reference's weighting scheme).
+    """
+
+    init_state: Callable[[Pytree], Any]
+    aggregate: Callable[..., tuple[Pytree, Any, dict]]
+    name: str = "aggregator"
+
+
+def fedavg_aggregator() -> Aggregator:
+    """Sample-count-weighted averaging (FedAVGAggregator.py:59-88)."""
+
+    def init_state(global_variables):
+        return ()
+
+    def aggregate(global_variables, stacked, weights, state, rng):
+        new_global = treelib.tree_weighted_mean(stacked, weights)
+        return new_global, state, {}
+
+    return Aggregator(init_state, aggregate, name="fedavg")

@@ -1,0 +1,41 @@
+"""RNG discipline.
+
+The reference seeds numpy/torch globally (fedml_experiments/distributed/fedavg/
+main_fedavg.py:448-451) and re-seeds client sampling per round with the round
+index (fedml_api/distributed/fedavg/FedAVGAggregator.py:90-98). JAX requires
+explicit threaded PRNG keys; this module reproduces the *semantics* (determinism,
+per-round sampling reproducibility) with explicit key derivation.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def root_key(seed: int) -> jax.Array:
+    return jax.random.key(seed)
+
+
+def round_key(key: jax.Array, round_idx: int) -> jax.Array:
+    """Key for everything that happens inside one FL round."""
+    return jax.random.fold_in(key, round_idx)
+
+
+def client_keys(key: jax.Array, num_clients: int) -> jax.Array:
+    """One independent key per client slot (stacked, vmap-able)."""
+    return jax.random.split(key, num_clients)
+
+
+def sample_clients(round_idx: int, client_num_in_total: int, client_num_per_round: int) -> np.ndarray:
+    """Reproduce the reference's client-sampling sequence exactly.
+
+    Reference (FedAVGAggregator.client_sampling, FedAVGAggregator.py:90-98):
+    ``np.random.seed(round_idx); np.random.choice(range(N), k, replace=False)``.
+    Kept host-side numpy on purpose so runs can be compared 1:1 against the
+    reference's sampled cohorts.
+    """
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total)
+    rng = np.random.RandomState(round_idx)
+    return rng.choice(client_num_in_total, client_num_per_round, replace=False)

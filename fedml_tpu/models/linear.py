@@ -1,0 +1,20 @@
+"""Linear models (reference: fedml_api/model/linear/lr.py:4).
+
+The reference LogisticRegression is Linear(784 -> C) + sigmoid trained with a
+CE criterion; here it is a Flax Dense producing logits — the loss applies the
+link function, which is the numerically-stable idiom.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(jnp.float32)
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,44 @@
+"""Device-mesh construction for federated simulation.
+
+The reference's process topology (one MPI rank per client + one server rank,
+fedml_api/distributed/fedavg/FedAvgAPI.py:13-17) maps onto a JAX device mesh:
+the ``clients`` axis carries cohort/client parallelism (the FL analogue of DP),
+and an optional ``silo`` axis carries intra-client data parallelism — the
+analogue of the reference's intra-silo DDP (fedavg_cross_silo/
+process_group_manager.py:23-27, NCCL) riding ICI instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+CLIENT_AXIS = "clients"
+SILO_AXIS = "silo"
+
+
+def client_mesh(devices=None) -> Mesh:
+    """1-D mesh: every device is a client slot."""
+    devices = list(devices if devices is not None else jax.devices())
+    return Mesh(np.asarray(devices), (CLIENT_AXIS,))
+
+
+def silo_mesh(num_silos: int, devices=None) -> Mesh:
+    """2-D mesh [clients, silo]: cohort parallelism × intra-silo DP."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % num_silos:
+        raise ValueError(f"{n} devices not divisible into {num_silos} silo groups")
+    arr = np.asarray(devices).reshape(num_silos, n // num_silos)
+    return Mesh(arr, (CLIENT_AXIS, SILO_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def client_sharded(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (client) axis of every leaf over the clients axis."""
+    return NamedSharding(mesh, P(CLIENT_AXIS))

@@ -1,0 +1,132 @@
+"""Host-side cohort staging: ragged client shards -> fixed-shape device stacks.
+
+The reference swaps per-client torch DataLoaders into a fixed pool of Client
+objects each round (standalone/fedavg/fedavg_api.py:32-66). The TPU analogue:
+for each round's cohort, gather the sampled clients' samples into one padded
+array stack ``[C, S, B, ...]`` (C clients × S steps × B batch) with an example
+mask, and ship it to device once. Shapes are identical every round, so the
+round program compiles exactly once.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class FederatedArrays:
+    """An in-memory federated dataset.
+
+    ``arrays``: field name -> [N, ...] numpy array (must include "x" and "y";
+    may include a per-token "mask" for sequence tasks).
+    ``partition``: client id -> sorted sample indices into those arrays
+    (the 8-tuple contract's train_data_local_dict, flattened to indices).
+    """
+
+    arrays: dict[str, np.ndarray]
+    partition: dict[int, np.ndarray]
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.partition)
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.arrays["y"])
+
+    def client_sizes(self) -> np.ndarray:
+        return np.asarray([len(self.partition[i]) for i in range(self.num_clients)])
+
+    def max_client_size(self) -> int:
+        return int(self.client_sizes().max())
+
+
+def steps_per_epoch(max_client_size: int, batch_size: int) -> int:
+    return max(1, -(-max_client_size // batch_size))
+
+
+def stack_cohort(
+    data: FederatedArrays,
+    client_ids: np.ndarray,
+    batch_size: int,
+    steps: int | None = None,
+    rng: np.random.RandomState | None = None,
+) -> tuple[dict[str, np.ndarray], np.ndarray]:
+    """Build the round's training stack.
+
+    Returns ``(batch_stack, num_samples)`` where batch_stack leaves are
+    [C, S, B, ...] and num_samples is [C] float32 true sample counts (the
+    aggregation weights, FedAVGAggregator.py:59-88). ``steps`` pins S so every
+    round has identical shapes; default = fit the largest cohort member.
+    ``rng`` shuffles each client's sample order (torch DataLoader shuffle
+    semantics).
+    """
+    C = len(client_ids)
+    sizes = np.asarray([len(data.partition[int(c)]) for c in client_ids])
+    if steps is None:
+        steps = steps_per_epoch(int(sizes.max()), batch_size)
+    slots = steps * batch_size
+
+    stack: dict[str, np.ndarray] = {}
+    for name, arr in data.arrays.items():
+        out = np.zeros((C, slots) + arr.shape[1:], dtype=arr.dtype)
+        stack[name] = out
+    mask = np.zeros((C, slots), dtype=np.float32)
+
+    for ci, cid in enumerate(client_ids):
+        idxs = data.partition[int(cid)]
+        if rng is not None:
+            idxs = rng.permutation(idxs)
+        n = min(len(idxs), slots)
+        for name, arr in data.arrays.items():
+            stack[name][ci, :n] = arr[idxs[:n]]
+        mask[ci, :n] = 1.0
+
+    batch_stack = {
+        name: arr.reshape((C, steps, batch_size) + arr.shape[2:])
+        for name, arr in stack.items()
+    }
+    example_mask = mask.reshape(C, steps, batch_size)
+    if "mask" in batch_stack:
+        # sequence tasks: combine per-token mask with example validity
+        tok = batch_stack["mask"].astype(np.float32)
+        batch_stack["mask"] = tok * example_mask.reshape(example_mask.shape + (1,) * (tok.ndim - 3))
+    else:
+        batch_stack["mask"] = example_mask
+    return batch_stack, sizes.astype(np.float32)
+
+
+def batch_array(arrays: dict[str, np.ndarray], batch_size: int) -> dict[str, np.ndarray]:
+    """Batch a flat dataset into [S, B, ...] with padding mask — used for
+    centralized training and global eval."""
+    n = len(arrays["y"])
+    steps = steps_per_epoch(n, batch_size)
+    slots = steps * batch_size
+    out = {}
+    for name, arr in arrays.items():
+        padded = np.zeros((slots,) + arr.shape[1:], dtype=arr.dtype)
+        padded[:n] = arr
+        out[name] = padded.reshape((steps, batch_size) + arr.shape[1:])
+    mask = np.zeros((slots,), dtype=np.float32)
+    mask[:n] = 1.0
+    mask = mask.reshape(steps, batch_size)
+    if "mask" in out:
+        tok = out["mask"].astype(np.float32)
+        out["mask"] = tok * mask.reshape(mask.shape + (1,) * (tok.ndim - 2))
+    else:
+        out["mask"] = mask
+    return out
+
+
+def stack_client_eval(
+    data: FederatedArrays, client_ids: np.ndarray, batch_size: int, steps: int | None = None
+) -> dict[str, np.ndarray]:
+    """[C, S, B, ...] eval stack over given clients (no shuffling)."""
+    stack, _ = stack_cohort(data, client_ids, batch_size, steps=steps, rng=None)
+    return stack

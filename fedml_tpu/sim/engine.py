@@ -1,0 +1,234 @@
+"""The vectorized federated-simulation engine.
+
+Replaces the reference's entire distributed actor system for the simulation
+paradigm (SURVEY §3.1/§3.2): instead of W+1 MPI processes exchanging pickled
+state_dicts, one jitted XLA program runs the whole round — ``vmap`` over the
+cohort's client axis (sharded over the device mesh), ``lax.scan`` over local
+epochs/steps, and a weighted all-reduce for aggregation. The 0.3 s polling
+loops, per-message pickling, and serial client loop of the reference
+(mpi/com_manager.py:71-78, fedavg_api.py:56-66) have no equivalent here — they
+are compiled away.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
+from fedml_tpu.core import rng as rnglib
+from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
+from fedml_tpu.parallel import mesh as meshlib
+from fedml_tpu.sim import cohort as cohortlib
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """Flag names follow the reference CLI (main_fedavg.py:46-130)."""
+
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    batch_size: int = 32
+    comm_round: int = 10
+    epochs: int = 1  # local epochs per round
+    frequency_of_the_test: int = 1
+    eval_batch_size: int = 256
+    seed: int = 0
+    shuffle_each_round: bool = True
+
+
+class FedSim:
+    """Single-program federated simulator.
+
+    Parameters
+    ----------
+    trainer: ClientTrainer (module + task + local optimizer + epochs)
+    train_data: FederatedArrays (client-partitioned train set)
+    test_arrays: dict of [N, ...] arrays — pooled global test set
+    aggregator: server aggregation rule; defaults to FedAvg weighted mean
+    mesh: jax Mesh with a "clients" axis; defaults to all local devices
+    """
+
+    def __init__(
+        self,
+        trainer: ClientTrainer,
+        train_data: cohortlib.FederatedArrays,
+        test_arrays: dict[str, np.ndarray] | None,
+        config: SimConfig,
+        aggregator: Aggregator | None = None,
+        mesh=None,
+    ):
+        self.trainer = trainer
+        self.train_data = train_data
+        self.config = config
+        self.aggregator = aggregator or fedavg_aggregator()
+        self.mesh = mesh if mesh is not None else meshlib.client_mesh()
+
+        self._local_train = make_local_train(trainer)
+        self._local_eval = make_local_eval(trainer)
+
+        # Pin steps-per-epoch to the global max so every round compiles once.
+        self._steps = cohortlib.steps_per_epoch(
+            train_data.max_client_size(), config.batch_size
+        )
+
+        self._rep = meshlib.replicated(self.mesh)
+        self._shard = meshlib.client_sharded(self.mesh)
+
+        self._round_fn = jax.jit(
+            self._round_impl,
+            donate_argnums=(0,),
+        )
+        self._eval_fn = jax.jit(self._eval_impl)
+
+        self._test_batches = (
+            cohortlib.batch_array(test_arrays, config.eval_batch_size)
+            if test_arrays is not None
+            else None
+        )
+        self._train_eval_batches = cohortlib.batch_array(
+            train_data.arrays, config.eval_batch_size
+        )
+
+    # -- jitted programs -----------------------------------------------------
+
+    def _round_impl(self, global_variables, server_state, batches, weights, rng):
+        keys = jax.random.split(rng, weights.shape[0])
+        local_vars, train_metrics = jax.vmap(
+            self._local_train, in_axes=(None, 0, 0)
+        )(global_variables, batches, keys)
+        new_global, server_state, agg_metrics = self.aggregator.aggregate(
+            global_variables, local_vars, weights, server_state, rng
+        )
+        metrics = {
+            "Train/Loss": jnp.sum(
+                train_metrics["train_loss"] * weights / jnp.sum(weights)
+            ),
+            **agg_metrics,
+        }
+        return new_global, server_state, metrics
+
+    def _eval_impl(self, variables, batches):
+        def step(carry, batch):
+            return carry, self.trainer.eval_batch(variables, batch)
+
+        _, m = jax.lax.scan(step, 0, batches)
+        summed = jax.tree.map(lambda x: jnp.sum(x, axis=0), m)
+        total = jnp.maximum(summed["test_total"], 1.0)
+        return {
+            "Acc": summed["test_correct"] / total,
+            "Loss": summed["test_loss"] / total,
+        }
+
+    # -- host driver ---------------------------------------------------------
+
+    def init_variables(self) -> Pytree:
+        sample = {
+            name: jnp.asarray(arr[: self.config.batch_size])
+            for name, arr in self.train_data.arrays.items()
+        }
+        sample.setdefault("mask", jnp.ones((self.config.batch_size,), jnp.float32))
+        return self.trainer.init(jax.random.key(self.config.seed), sample)
+
+    def stage_round(self, round_idx: int):
+        """Sample the cohort and stage its data on device."""
+        cfg = self.config
+        cohort = rnglib.sample_clients(
+            round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+        )
+        shuffle = (
+            np.random.RandomState(cfg.seed * 1_000_003 + round_idx)
+            if cfg.shuffle_each_round
+            else None
+        )
+        batches, weights = cohortlib.stack_cohort(
+            self.train_data, cohort, cfg.batch_size, steps=self._steps, rng=shuffle
+        )
+        # Pad the cohort axis to a multiple of the mesh's client axis with
+        # zero-weight dummy clients (fully masked, excluded from the weighted
+        # aggregation) so the stack shards evenly over devices.
+        n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
+        C = len(cohort)
+        pad = (-C) % n_dev
+        if pad:
+            batches = {
+                k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+                for k, v in batches.items()
+            }
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+        batches = jax.device_put(batches, self._shard)
+        weights = jax.device_put(jnp.asarray(weights), self._rep)
+        return cohort, batches, weights
+
+    def run_round(self, round_idx, global_variables, server_state, root_rng):
+        _, batches, weights = self.stage_round(round_idx)
+        rkey = rnglib.round_key(root_rng, round_idx)
+        return self._round_fn(global_variables, server_state, batches, weights, rkey)
+
+    def evaluate(self, variables) -> dict[str, float]:
+        out = {}
+        train_m = self._eval_fn(variables, self._train_eval_batches)
+        out["Train/Acc"] = float(train_m["Acc"])
+        out["Train/Loss"] = float(train_m["Loss"])
+        if self._test_batches is not None:
+            test_m = self._eval_fn(variables, self._test_batches)
+            out["Test/Acc"] = float(test_m["Acc"])
+            out["Test/Loss"] = float(test_m["Loss"])
+        return out
+
+    def run(self, callback=None) -> tuple[Pytree, list[dict]]:
+        cfg = self.config
+        variables = jax.device_put(self.init_variables(), self._rep)
+        server_state = self.aggregator.init_state(variables)
+        root = rnglib.root_key(cfg.seed)
+        history = []
+        for r in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            variables, server_state, metrics = self.run_round(
+                r, variables, server_state, root
+            )
+            jax.block_until_ready(variables)
+            rec = {"round": r, "round_time": time.perf_counter() - t0}
+            rec.update({k: float(v) for k, v in metrics.items()})
+            if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+                rec.update(self.evaluate(variables))
+            history.append(rec)
+            if callback:
+                callback(rec)
+            logging.info("round %d: %s", r, {k: v for k, v in rec.items() if k != "round"})
+        return variables, history
+
+
+# ---------------------------------------------------------------------------
+# Centralized baseline (reference fedml_api/centralized/centralized_trainer.py:9)
+# — used by the FedAvg ≡ centralized equivalence oracle (CI-script-fedavg.sh:41-47).
+# ---------------------------------------------------------------------------
+
+
+def centralized_train(
+    trainer: ClientTrainer,
+    arrays: dict[str, np.ndarray],
+    batch_size: int,
+    num_epochs: int,
+    seed: int = 0,
+):
+    """Train on the pooled dataset with the same jitted machinery."""
+    batches = cohortlib.batch_array(arrays, batch_size)
+    sample = jax.tree.map(lambda x: jnp.asarray(x[0]), batches)
+    variables = trainer.init(jax.random.key(seed), sample)
+    local_train = make_local_train(
+        dataclasses.replace(trainer, epochs=num_epochs)
+    )
+    fn = jax.jit(local_train)
+    variables, metrics = fn(variables, jax.tree.map(jnp.asarray, batches), jax.random.key(seed + 1))
+    return variables, metrics

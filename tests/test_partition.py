@@ -1,0 +1,57 @@
+"""Property tests for the partitioners (SURVEY §7 layer 1)."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import partition as P
+
+
+LABELS = np.random.RandomState(1).randint(0, 10, 5000)
+
+
+def _check_disjoint_cover(parts, n):
+    allidx = np.concatenate([parts[i] for i in range(len(parts))])
+    assert len(allidx) == n
+    assert len(np.unique(allidx)) == n
+
+
+def test_homo_sizes_sum():
+    parts = P.homo_partition(1000, 7, seed=3)
+    _check_disjoint_cover(parts, 1000)
+    sizes = [len(parts[i]) for i in range(7)]
+    assert max(sizes) - min(sizes) <= 1
+
+
+def test_dirichlet_cover_and_min_size():
+    parts = P.dirichlet_partition(LABELS, 10, alpha=0.5, seed=0)
+    _check_disjoint_cover(parts, len(LABELS))
+    assert min(len(parts[i]) for i in range(10)) >= 10
+
+
+def test_dirichlet_large_alpha_is_roughly_uniform():
+    parts = P.dirichlet_partition(LABELS, 10, alpha=1000.0, seed=0)
+    sizes = np.array([len(parts[i]) for i in range(10)])
+    assert sizes.std() / sizes.mean() < 0.25
+
+
+def test_dirichlet_small_alpha_is_skewed():
+    parts = P.dirichlet_partition(LABELS, 10, alpha=0.05, seed=0)
+    stats = P.record_data_stats(LABELS, parts)
+    # each client should be dominated by few classes
+    per_client_classes = [len(stats[i]) for i in range(10)]
+    assert np.mean(per_client_classes) < 9
+
+
+def test_powerlaw_sizes():
+    parts = P.powerlaw_partition(LABELS, 50, seed=0)
+    _check_disjoint_cover(parts, len(LABELS))
+    sizes = np.array([len(parts[i]) for i in range(50)])
+    assert sizes.max() > 3 * sizes.min()
+
+
+def test_dispatch():
+    for m in ["homo", "hetero", "power-law"]:
+        parts = P.partition(m, LABELS, 5, 0.5, 0)
+        _check_disjoint_cover(parts, len(LABELS))
+    with pytest.raises(ValueError):
+        P.partition("bogus", LABELS, 5)
