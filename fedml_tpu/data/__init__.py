@@ -1,0 +1,2 @@
+from fedml_tpu.data.registry import FedDataset, load_partition_data
+from fedml_tpu.data.synthetic import gaussian_blobs, synthetic_classification

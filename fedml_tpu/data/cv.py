@@ -1,0 +1,125 @@
+"""Synthetically-partitioned CV datasets: CIFAR-10/100, CINIC-10.
+
+Reference: fedml_api/data_preprocessing/cifar10/data_loader.py — download,
+normalize (mean/std constants :31-44), ``partition_data`` homo/hetero/
+hetero-fix (:113-161), truncated per-client datasets, Cutout augmentation.
+Here: read the standard python-pickle batches from a local directory (no
+network), partition with :mod:`fedml_tpu.core.partition`, and return
+FederatedArrays. Augmentation (crop/flip/cutout) runs on-device — see
+:mod:`fedml_tpu.ops.augment`.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+from pathlib import Path
+
+import numpy as np
+
+from fedml_tpu.core import partition as partlib
+from fedml_tpu.sim.cohort import FederatedArrays
+
+CIFAR10_MEAN = np.asarray([0.49139968, 0.48215827, 0.44653124], np.float32)
+CIFAR10_STD = np.asarray([0.24703233, 0.24348505, 0.26158768], np.float32)
+CIFAR100_MEAN = np.asarray([0.5071, 0.4865, 0.4409], np.float32)
+CIFAR100_STD = np.asarray([0.2673, 0.2564, 0.2762], np.float32)
+CINIC10_MEAN = np.asarray([0.47889522, 0.47227842, 0.43047404], np.float32)
+CINIC10_STD = np.asarray([0.24205776, 0.23828046, 0.25874835], np.float32)
+
+
+def _find_cifar_dir(data_dir: str | Path, names: list[str]) -> Path | None:
+    for name in names:
+        p = Path(data_dir) / name
+        if p.is_dir():
+            return p
+    return None
+
+
+def _load_cifar10_raw(data_dir: str | Path):
+    d = _find_cifar_dir(data_dir, ["cifar-10-batches-py", "."])
+    if d is None or not (d / "data_batch_1").exists():
+        return None
+    xs, ys = [], []
+    for i in range(1, 6):
+        with open(d / f"data_batch_{i}", "rb") as fh:
+            blob = pickle.load(fh, encoding="bytes")
+        xs.append(blob[b"data"])
+        ys.extend(blob[b"labels"])
+    with open(d / "test_batch", "rb") as fh:
+        blob = pickle.load(fh, encoding="bytes")
+    xt, yt = blob[b"data"], blob[b"labels"]
+    x = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    xt = np.asarray(xt).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return (x, np.asarray(ys, np.int32)), (xt, np.asarray(yt, np.int32)), 10
+
+
+def _load_cifar100_raw(data_dir: str | Path):
+    d = _find_cifar_dir(data_dir, ["cifar-100-python", "."])
+    if d is None or not (d / "train").exists():
+        return None
+    with open(d / "train", "rb") as fh:
+        tr = pickle.load(fh, encoding="bytes")
+    with open(d / "test", "rb") as fh:
+        te = pickle.load(fh, encoding="bytes")
+    x = tr[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    xt = te[b"data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    return (
+        (x, np.asarray(tr[b"fine_labels"], np.int32)),
+        (xt, np.asarray(te[b"fine_labels"], np.int32)),
+        100,
+    )
+
+
+def _normalize(x: np.ndarray, mean, std) -> np.ndarray:
+    return ((x.astype(np.float32) / 255.0) - mean) / std
+
+
+def _synthetic_cifar_like(num_classes: int, n: int = 2000, seed: int = 0):
+    """Hermetic fixture with CIFAR shapes when the real files are absent."""
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(num_classes, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, num_classes, n).astype(np.int32)
+    x = np.clip(centers[y] + rng.normal(0, 0.25, (n, 32, 32, 3)), 0, 1).astype(np.float32)
+    yt = rng.randint(0, num_classes, n // 5).astype(np.int32)
+    xt = np.clip(centers[yt] + rng.normal(0, 0.25, (n // 5, 32, 32, 3)), 0, 1).astype(np.float32)
+    return (x * 255, y), (xt * 255, yt), num_classes
+
+
+def load_cifar(
+    dataset: str,
+    data_dir: str | Path,
+    partition_method: str = "hetero",
+    partition_alpha: float = 0.5,
+    client_number: int = 10,
+    seed: int = 0,
+    allow_synthetic: bool = True,
+):
+    """Returns (train FederatedArrays, pooled test arrays, class_num).
+
+    Mirrors load_partition_data_cifar10 (cifar10/data_loader.py:235) with the
+    dicts replaced by the FederatedArrays partition.
+    """
+    if dataset in ("cifar10", "cinic10"):
+        raw = _load_cifar10_raw(data_dir)
+        mean, std = (CIFAR10_MEAN, CIFAR10_STD) if dataset == "cifar10" else (CINIC10_MEAN, CINIC10_STD)
+        nclass = 10
+    elif dataset == "cifar100":
+        raw = _load_cifar100_raw(data_dir)
+        mean, std = CIFAR100_MEAN, CIFAR100_STD
+        nclass = 100
+    else:
+        raise ValueError(f"unknown CV dataset {dataset!r}")
+
+    if raw is None:
+        if not allow_synthetic:
+            raise FileNotFoundError(f"{dataset} files not found under {data_dir}")
+        raw = _synthetic_cifar_like(nclass, seed=seed)
+
+    (x, y), (xt, yt), class_num = raw
+    x = _normalize(x, mean, std)
+    xt = _normalize(xt, mean, std)
+    part = partlib.partition(partition_method, y, client_number, partition_alpha, seed)
+    train = FederatedArrays({"x": x, "y": y}, part)
+    return train, {"x": xt, "y": yt}, class_num

@@ -1,0 +1,34 @@
+from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg
+from fedml_tpu.models.gan import Discriminator, Generator
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
+from fedml_tpu.models.registry import create_model, task_for_dataset
+from fedml_tpu.models.resnet import (
+    CifarResNet,
+    ResNet18,
+    resnet18_gn,
+    resnet56,
+    resnet110,
+)
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.vgg import VGG
+
+__all__ = [
+    "CNNDropOut",
+    "CNNOriginalFedAvg",
+    "CifarResNet",
+    "Discriminator",
+    "Generator",
+    "LogisticRegression",
+    "MobileNet",
+    "MobileNetV3",
+    "ResNet18",
+    "RNNOriginalFedAvg",
+    "RNNStackOverflow",
+    "VGG",
+    "create_model",
+    "resnet18_gn",
+    "resnet56",
+    "resnet110",
+    "task_for_dataset",
+]

@@ -1,0 +1,58 @@
+"""FedAvg-paper CNNs (reference: fedml_api/model/cv/cnn.py:5 CNN_OriginalFedAvg,
+:74 CNN_DropOut).
+
+Architecture (McMahan et al. 2017 / TFF baselines): two 5x5 conv layers
+(32, 64 channels) each followed by 2x2 max-pool, then a 512-unit dense layer
+and the classifier head. ``CNN_DropOut`` is the TFF variant with 3x3 convs and
+dropout. Inputs are NHWC float images ([B, 28, 28] or [B, 28, 28, 1]);
+channels-last is the TPU-friendly layout.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _ensure_nhwc(x):
+    if x.ndim == 3:
+        x = x[..., None]
+    return x.astype(jnp.float32)
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """2x(conv5x5 + maxpool) + FC512 + head; ~1.66M params for femnist."""
+
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ensure_nhwc(x)
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    """TFF dropout variant (cnn.py:74): conv3x3(32) → conv3x3(64) → pool →
+    dropout(.25) → FC128 → dropout(.5) → head."""
+
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = _ensure_nhwc(x)
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)

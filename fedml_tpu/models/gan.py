@@ -1,0 +1,36 @@
+"""MNIST GAN (reference: fedml_api/model/cv/mnist_gan.py:6 Generator /
+Discriminator — the fedgan workload, which federates a dict of the two
+networks and aggregates them with a nested weighted average,
+FedGANAggregator.aggregate:58-88)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    latent_dim: int = 100
+    img_shape: tuple[int, int, int] = (28, 28, 1)
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        h = z.astype(jnp.float32)
+        for width, norm in [(128, False), (256, True), (512, True), (1024, True)]:
+            h = nn.Dense(width)(h)
+            if norm:
+                h = nn.BatchNorm(use_running_average=not train, momentum=0.8)(h)
+            h = nn.leaky_relu(h, 0.2)
+        h = nn.tanh(nn.Dense(int(jnp.prod(jnp.asarray(self.img_shape))))(h))
+        return h.reshape((h.shape[0],) + self.img_shape)
+
+
+class Discriminator(nn.Module):
+    img_shape: tuple[int, int, int] = (28, 28, 1)
+
+    @nn.compact
+    def __call__(self, img, train: bool = False):
+        h = img.reshape((img.shape[0], -1)).astype(jnp.float32)
+        h = nn.leaky_relu(nn.Dense(512)(h), 0.2)
+        h = nn.leaky_relu(nn.Dense(256)(h), 0.2)
+        return nn.Dense(1)(h)  # logit; loss applies sigmoid

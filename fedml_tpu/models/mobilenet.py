@@ -1,0 +1,139 @@
+"""MobileNet V1 and V3 (reference: fedml_api/model/cv/mobilenet.py:207
+``mobilenet``, cv/mobilenet_v3.py:137 ``MobileNetV3`` — the cross-silo CV
+models).
+
+Depthwise separable convolutions map to the TPU as grouped convs;
+channels-last NHWC throughout.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=self.stride, padding="SAME",
+                    feature_group_count=in_ch, use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
+        return nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+
+
+class MobileNet(nn.Module):
+    """MobileNet V1 (width 1.0). ``small_input`` keeps stride-1 stem for CIFAR."""
+
+    num_classes: int = 10
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        stem_stride = 1 if self.small_input else 2
+        x = nn.Conv(32, (3, 3), strides=stem_stride, padding="SAME", use_bias=False)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+        for filters, stride in cfg:
+            x = DepthwiseSeparable(filters, stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _hard_sigmoid(x):
+    return nn.relu6(x + 3.0) / 6.0
+
+
+def _hard_swish(x):
+    return x * _hard_sigmoid(x)
+
+
+class SqueezeExcite(nn.Module):
+    reduce: int = 4
+
+    @nn.compact
+    def __call__(self, x):
+        ch = x.shape[-1]
+        s = jnp.mean(x, axis=(1, 2))
+        s = nn.relu(nn.Dense(max(ch // self.reduce, 8))(s))
+        s = _hard_sigmoid(nn.Dense(ch)(s))
+        return x * s[:, None, None, :]
+
+
+class InvertedResidual(nn.Module):
+    expand: int
+    filters: int
+    kernel: int
+    stride: int
+    use_se: bool
+    use_hs: bool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        act = _hard_swish if self.use_hs else nn.relu
+        inp = x.shape[-1]
+        y = x
+        if self.expand != inp:
+            y = nn.Conv(self.expand, (1, 1), use_bias=False)(y)
+            y = act(nn.BatchNorm(use_running_average=not train)(y))
+        y = nn.Conv(self.expand, (self.kernel, self.kernel), strides=self.stride,
+                    padding="SAME", feature_group_count=self.expand, use_bias=False)(y)
+        y = act(nn.BatchNorm(use_running_average=not train)(y))
+        if self.use_se:
+            y = SqueezeExcite()(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
+        y = nn.BatchNorm(use_running_average=not train)(y)
+        if self.stride == 1 and inp == self.filters:
+            y = y + x
+        return y
+
+
+# (expand, filters, kernel, stride, SE, hard-swish) per mobilenet_v3 paper
+_V3_LARGE = [
+    (16, 16, 3, 1, False, False), (64, 24, 3, 2, False, False),
+    (72, 24, 3, 1, False, False), (72, 40, 5, 2, True, False),
+    (120, 40, 5, 1, True, False), (120, 40, 5, 1, True, False),
+    (240, 80, 3, 2, False, True), (200, 80, 3, 1, False, True),
+    (184, 80, 3, 1, False, True), (184, 80, 3, 1, False, True),
+    (480, 112, 3, 1, True, True), (672, 112, 3, 1, True, True),
+    (672, 160, 5, 2, True, True), (960, 160, 5, 1, True, True),
+    (960, 160, 5, 1, True, True),
+]
+_V3_SMALL = [
+    (16, 16, 3, 2, True, False), (72, 24, 3, 2, False, False),
+    (88, 24, 3, 1, False, False), (96, 40, 5, 2, True, True),
+    (240, 40, 5, 1, True, True), (240, 40, 5, 1, True, True),
+    (120, 48, 5, 1, True, True), (144, 48, 5, 1, True, True),
+    (288, 96, 5, 2, True, True), (576, 96, 5, 1, True, True),
+    (576, 96, 5, 1, True, True),
+]
+
+
+class MobileNetV3(nn.Module):
+    num_classes: int = 10
+    mode: str = "small"
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(jnp.float32)
+        cfg = _V3_SMALL if self.mode == "small" else _V3_LARGE
+        stem_stride = 1 if self.small_input else 2
+        x = nn.Conv(16, (3, 3), strides=stem_stride, padding="SAME", use_bias=False)(x)
+        x = _hard_swish(nn.BatchNorm(use_running_average=not train)(x))
+        for block_cfg in cfg:
+            x = InvertedResidual(*block_cfg)(x, train=train)
+        head = 576 if self.mode == "small" else 960
+        x = nn.Conv(head, (1, 1), use_bias=False)(x)
+        x = _hard_swish(nn.BatchNorm(use_running_average=not train)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = _hard_swish(nn.Dense(1280 if self.mode == "large" else 1024)(x))
+        return nn.Dense(self.num_classes)(x)

@@ -1,0 +1,60 @@
+"""Model registry: (model_name, dataset) -> Flax module, mirroring the
+reference dispatch (fedml_experiments/distributed/fedavg/main_fedavg.py:354-390
+``create_model``) so reference run configs translate 1:1."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg
+from fedml_tpu.models.gan import Discriminator, Generator
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
+from fedml_tpu.models.resnet import ResNet18, resnet18_gn, resnet56, resnet110
+from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.vgg import VGG
+
+
+def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
+    """Reference name/dataset dispatch (main_fedavg.py:354-390). Returns the
+    Flax module; task selection (classification/nwp/tag) is the trainer's job
+    as in the reference (FedAvgAPI.py:85-91)."""
+    if model_name == "lr" and dataset == "stackoverflow_lr":
+        return LogisticRegression(num_classes=output_dim)  # 10004-dim input handled by data
+    if model_name == "lr":
+        return LogisticRegression(num_classes=output_dim)
+    if model_name == "rnn" and dataset == "stackoverflow_nwp":
+        return RNNStackOverflow()
+    if model_name == "rnn":  # shakespeare / fed_shakespeare
+        return RNNOriginalFedAvg()
+    if model_name == "cnn":  # femnist
+        return CNNDropOut(num_classes=output_dim)
+    if model_name == "cnn_original":
+        return CNNOriginalFedAvg(num_classes=output_dim)
+    if model_name == "resnet18_gn":
+        return resnet18_gn(class_num=output_dim)
+    if model_name == "resnet56":
+        return resnet56(class_num=output_dim)
+    if model_name == "resnet110":
+        return resnet110(class_num=output_dim)
+    if model_name == "mobilenet":
+        return MobileNet(num_classes=output_dim)
+    if model_name == "mobilenet_v3":
+        return MobileNetV3(num_classes=output_dim, mode="large")
+    if model_name.startswith("vgg"):
+        depth = int(model_name[3:] or 16)
+        return VGG(depth=depth, num_classes=output_dim)
+    raise ValueError(f"unknown model {model_name!r} (dataset={dataset!r})")
+
+
+TASK_BY_DATASET = {
+    # reference trainer dispatch (fedml_api/distributed/fedavg/FedAvgAPI.py:85-91)
+    "stackoverflow_lr": "tag",
+    "stackoverflow_nwp": "nwp",
+    "shakespeare": "char_lm",
+    "fed_shakespeare": "char_lm",
+}
+
+
+def task_for_dataset(dataset: str) -> str:
+    return TASK_BY_DATASET.get(dataset, "classification")

@@ -1,0 +1,109 @@
+"""ResNet family.
+
+- CIFAR-style ResNet-56/110 with BatchNorm (reference: fedml_api/model/cv/
+  resnet.py:202 ``resnet56``, :225 ``resnet110`` — 3 stages of (depth-2)/6
+  BasicBlocks, 16/32/64 channels, option-A shortcuts).
+- ResNet-18 with GroupNorm for fed_cifar100 (reference: cv/resnet_gn.py:183 +
+  custom group_normalization.py — the Adaptive-FedOpt paper configuration;
+  GN avoids federating BN statistics entirely).
+
+TPU notes: NHWC layout, channels padded by XLA onto the MXU; BatchNorm state
+(``batch_stats`` collection) is federated by averaging alongside weights, the
+reference's deliberate policy (FedAVGAggregator.py:74-81).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _norm(kind: str, train: bool):
+    if kind == "bn":
+        return partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, epsilon=1e-5)
+    if kind == "gn":
+        return partial(nn.GroupNorm, num_groups=2)
+    raise ValueError(f"unknown norm {kind!r}")
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    stride: int = 1
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train)
+        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding="SAME", use_bias=False)(x)
+        y = nn.relu(norm()(y))
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = norm()(y)
+        if x.shape[-1] != self.filters or self.stride != 1:
+            x = nn.Conv(self.filters, (1, 1), strides=self.stride, use_bias=False)(x)
+            x = norm()(x)
+        return nn.relu(x + y)
+
+
+class CifarResNet(nn.Module):
+    """3-stage CIFAR ResNet; depth = 6n+2 (56 -> n=9, 110 -> n=18)."""
+
+    depth: int = 56
+    num_classes: int = 10
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = (self.depth - 2) // 6
+        norm = _norm(self.norm, train)
+        x = x.astype(jnp.float32)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.relu(norm()(x))
+        for stage, filters in enumerate([16, 32, 64]):
+            for block in range(n):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+class ResNet18(nn.Module):
+    """Standard 4-stage ResNet-18; ``norm='gn'`` is the fed_cifar100 config
+    (resnet_gn.py:183). ``small_input`` uses a 3x3 stem without max-pool for
+    CIFAR-sized images."""
+
+    num_classes: int = 100
+    norm: str = "gn"
+    small_input: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train)
+        x = x.astype(jnp.float32)
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=2, padding="SAME", use_bias=False)(x)
+        x = nn.relu(norm()(x))
+        if not self.small_input:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, filters in enumerate([64, 128, 256, 512]):
+            for block in range(2):
+                stride = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(filters, stride, self.norm)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def resnet56(class_num: int = 10, norm: str = "bn") -> CifarResNet:
+    return CifarResNet(depth=56, num_classes=class_num, norm=norm)
+
+
+def resnet110(class_num: int = 10, norm: str = "bn") -> CifarResNet:
+    return CifarResNet(depth=110, num_classes=class_num, norm=norm)
+
+
+def resnet18_gn(class_num: int = 100) -> ResNet18:
+    return ResNet18(num_classes=class_num, norm="gn")

@@ -1,0 +1,45 @@
+"""Recurrent language models (reference: fedml_api/model/nlp/rnn.py).
+
+- ``RNNOriginalFedAvg`` (rnn.py:4): embedding(8) → 2×LSTM(256) → dense(V) —
+  Shakespeare next-char (McMahan 2017), 90-vocab.
+- ``RNNStackOverflow`` (rnn.py:39): embedding(96) → LSTM(670) → dense(96) →
+  dense(V) — StackOverflow next-word, 10k vocab + 4 special tokens.
+
+Inputs are int token ids [B, T]; outputs logits [B, T, V] (the trainer's LM
+loss applies the per-token mask). The recurrence is ``nn.RNN`` over an
+``OptimizedLSTMCell`` — XLA unrolls/scans it on-chip; the sequence axis stays
+static for jit.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        return nn.Dense(self.vocab_size)(h)
+
+
+class RNNStackOverflow(nn.Module):
+    """1 LSTM + 2 FC (rnn.py:39). vocab = 10000 words + pad/bos/eos/oov."""
+
+    vocab_size: int = 10004
+    embedding_dim: int = 96
+    hidden_size: int = 670
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size))(h)
+        h = nn.Dense(self.embedding_dim)(h)
+        return nn.Dense(self.vocab_size)(h)

@@ -1,0 +1,83 @@
+"""Data layer tests: 8-tuple contract, LEAF reader, registry dispatch."""
+
+import json
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import FedDataset, load_partition_data
+from fedml_tpu.data.leaf import load_leaf_classification, word_to_indices
+from fedml_tpu.data.registry import synthetic_char_lm, synthetic_tag_prediction
+
+
+def test_legacy_8tuple_contract():
+    ds = load_partition_data("mnist", data_dir="/nonexistent", client_num_in_total=12)
+    t = ds.as_legacy_tuple(batch_size=16)
+    (train_num, test_num, train_g, test_g, local_num, train_local, test_local, class_num) = t
+    assert class_num == 10
+    assert train_num == sum(local_num.values())
+    assert set(local_num) == set(range(12))
+    # batches are (x, y) pairs with matching lengths
+    xb, yb = train_local[0][0]
+    assert len(xb) == len(yb) and xb.shape[1:] == (28, 28)
+    assert sum(len(yb) for _, yb in train_g) == train_num
+
+
+def test_leaf_json_reader(tmp_path):
+    # two users, LEAF envelope (MNIST/data_loader.py:9-49 format)
+    blob = {
+        "users": ["u0", "u1"],
+        "num_samples": [3, 2],
+        "user_data": {
+            "u0": {"x": np.random.rand(3, 784).tolist(), "y": [0, 1, 2]},
+            "u1": {"x": np.random.rand(2, 784).tolist(), "y": [3, 4]},
+        },
+    }
+    for split in ("train", "test"):
+        (tmp_path / split).mkdir()
+        with open(tmp_path / split / "all_data.json", "w") as fh:
+            json.dump(blob, fh)
+    train, test, test_fed = load_leaf_classification(tmp_path / "train", tmp_path / "test")
+    assert train.num_clients == 2
+    assert train.num_samples == 5
+    np.testing.assert_array_equal(train.partition[0], [0, 1, 2])
+    assert train.arrays["x"].shape == (5, 28, 28)
+    assert test["y"].shape == (5,)
+
+
+def test_shakespeare_char_encoding():
+    idx = word_to_indices("hello")
+    assert len(idx) == 5
+    assert all(0 <= i < 90 for i in idx)
+
+
+def test_registry_cifar_synthetic_fallback():
+    ds = load_partition_data("cifar10", data_dir="/nonexistent", client_num_in_total=4)
+    assert ds.class_num == 10
+    assert ds.train.arrays["x"].shape[1:] == (32, 32, 3)
+    assert ds.train.num_clients == 4
+    # normalized floats
+    assert ds.train.arrays["x"].dtype == np.float32
+
+
+def test_registry_synthetic_family():
+    ds = load_partition_data("synthetic_0.5_0.5", client_num_in_total=6)
+    assert ds.train.num_clients == 6
+    assert ds.class_num == 10
+
+
+def test_char_lm_fixture_masks():
+    train, test, _ = synthetic_char_lm(n_clients=3, vocab=30, seq_len=10, samples=5)
+    assert train.arrays["x"].shape == (15, 10)
+    assert train.arrays["mask"].shape == (15, 10)
+    assert set(np.unique(train.arrays["mask"])) <= {0.0, 1.0}
+
+
+def test_tag_fixture():
+    train, test, _ = synthetic_tag_prediction(n_clients=3, dim=50, tags=20, samples=6)
+    assert train.arrays["y"].shape == (18, 20)
+
+
+def test_unknown_dataset():
+    with pytest.raises(ValueError):
+        load_partition_data("nope")

@@ -1,0 +1,154 @@
+"""Model zoo shape/param tests (reference analogue: fedml_api/model/cv/
+test_cnn.py FLOPs/param counting)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.core.tree import tree_size
+from fedml_tpu.models import (
+    CNNDropOut,
+    CNNOriginalFedAvg,
+    Discriminator,
+    Generator,
+    LogisticRegression,
+    MobileNet,
+    MobileNetV3,
+    RNNOriginalFedAvg,
+    RNNStackOverflow,
+    VGG,
+    create_model,
+    resnet56,
+    resnet18_gn,
+    task_for_dataset,
+)
+
+KEY = jax.random.key(0)
+
+
+def _init_and_apply(module, x, check_params=None):
+    variables = module.init({"params": KEY, "dropout": KEY}, x, train=False)
+    out = module.apply(variables, x, train=False)
+    out2, _ = module.apply(
+        variables, x, train=True,
+        mutable=["batch_stats"], rngs={"dropout": KEY},
+    )
+    assert out.shape == out2.shape
+    if check_params:
+        n = tree_size(variables["params"])
+        assert abs(n - check_params) / check_params < 0.35, n
+    return variables, out
+
+
+def test_lr():
+    x = jnp.ones((4, 28, 28))
+    _, out = _init_and_apply(LogisticRegression(num_classes=10), x, 7850)
+    assert out.shape == (4, 10)
+
+
+def test_cnn_original():
+    x = jnp.ones((2, 28, 28, 1))
+    _, out = _init_and_apply(CNNOriginalFedAvg(num_classes=62), x)
+    assert out.shape == (2, 62)
+
+
+def test_cnn_dropout():
+    x = jnp.ones((2, 28, 28, 1))
+    _, out = _init_and_apply(CNNDropOut(num_classes=62), x)
+    assert out.shape == (2, 62)
+
+
+def test_resnet56_params():
+    x = jnp.ones((2, 32, 32, 3))
+    # reference resnet56 ~0.86M params (resnet.py:202 CIFAR family)
+    variables, out = _init_and_apply(resnet56(class_num=10), x, 860_000)
+    assert out.shape == (2, 10)
+    assert "batch_stats" in variables
+
+
+def test_resnet18_gn():
+    x = jnp.ones((2, 24, 24, 3))
+    # ~11M params (resnet_gn.py:183)
+    variables, out = _init_and_apply(resnet18_gn(class_num=100), x, 11_000_000)
+    assert out.shape == (2, 100)
+    assert "batch_stats" not in variables  # GN has no federated running stats
+
+
+def test_mobilenet():
+    x = jnp.ones((2, 32, 32, 3))
+    variables, out = _init_and_apply(MobileNet(num_classes=10), x, 3_200_000)
+    assert out.shape == (2, 10)
+
+
+def test_mobilenet_v3_small():
+    x = jnp.ones((2, 32, 32, 3))
+    _, out = _init_and_apply(MobileNetV3(num_classes=10, mode="small"), x)
+    assert out.shape == (2, 10)
+
+
+def test_vgg11():
+    x = jnp.ones((2, 32, 32, 3))
+    _, out = _init_and_apply(VGG(depth=11, num_classes=10), x)
+    assert out.shape == (2, 10)
+
+
+def test_rnn_shakespeare():
+    x = jnp.ones((2, 20), jnp.int32)
+    # reference RNN_OriginalFedAvg: ~820k params (2xLSTM(256), 90 vocab)
+    _, out = _init_and_apply(RNNOriginalFedAvg(), x, 820_000)
+    assert out.shape == (2, 20, 90)
+
+
+def test_rnn_stackoverflow():
+    x = jnp.ones((2, 20), jnp.int32)
+    _, out = _init_and_apply(RNNStackOverflow(), x)
+    assert out.shape == (2, 20, 10004)
+
+
+def test_gan_shapes():
+    z = jnp.ones((3, 100))
+    gen = Generator()
+    gv = gen.init({"params": KEY}, z, train=False)
+    img = gen.apply(gv, z, train=False)
+    assert img.shape == (3, 28, 28, 1)
+    disc = Discriminator()
+    dv = disc.init({"params": KEY}, img, train=False)
+    logit = disc.apply(dv, img, train=False)
+    assert logit.shape == (3, 1)
+
+
+def test_registry_dispatch():
+    assert isinstance(create_model("lr", 10, "mnist"), LogisticRegression)
+    assert isinstance(create_model("rnn", 90, "shakespeare"), RNNOriginalFedAvg)
+    assert isinstance(create_model("rnn", 0, "stackoverflow_nwp"), RNNStackOverflow)
+    assert isinstance(create_model("cnn", 62, "femnist"), CNNDropOut)
+    assert isinstance(create_model("vgg16", 10), VGG)
+    with pytest.raises(ValueError):
+        create_model("nope", 10)
+    assert task_for_dataset("shakespeare") == "char_lm"
+    assert task_for_dataset("cifar10") == "classification"
+
+
+def test_cnn_trains_one_step():
+    """A CNN with dropout + a BN model goes through the ClientTrainer step."""
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+
+    x = np.random.RandomState(0).rand(2, 8, 8, 3).astype(np.float32)
+    batch = {
+        "x": jnp.asarray(x),
+        "y": jnp.asarray([0, 1]),
+        "mask": jnp.ones(2, jnp.float32),
+    }
+    tr = ClientTrainer(module=resnet56(class_num=4), optimizer=optax.sgd(0.1))
+    variables = tr.init(KEY, batch)
+    opt_state = tr.optimizer.init(variables["params"])
+    new_vars, _, loss = tr.train_step(variables, opt_state, variables["params"], batch, KEY)
+    assert jnp.isfinite(loss)
+    # batch_stats must have been updated by the training step
+    diff = jax.tree_util.tree_leaves(
+        jax.tree.map(lambda a, b: jnp.abs(a - b).sum(), variables["batch_stats"], new_vars["batch_stats"])
+    )
+    assert sum(float(d) for d in diff) > 0
