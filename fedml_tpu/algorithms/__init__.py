@@ -1,0 +1,5 @@
+from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
+from fedml_tpu.algorithms.fednova import fednova_aggregator, fednova_optimizer
+from fedml_tpu.algorithms.fedopt import fedopt_aggregator, server_optimizer
+from fedml_tpu.algorithms.fedprox import fedprox_aggregator, fedprox_trainer
+from fedml_tpu.algorithms.robust import RobustConfig, robust_aggregator

@@ -1,0 +1,150 @@
+"""FedGKT: Group Knowledge Transfer.
+
+Reference: fedml_api/distributed/fedgkt/ — clients train a small feature
+extractor locally (GKTClientTrainer.train:49+, returns per-batch
+extracted_feature_dict/logits_dict/labels_dict), the server trains a large
+model on those features with CE + temperature-scaled bidirectional KL
+distillation (GKTServerTrainer.py:13, train_and_eval:193+; KL_Loss
+utils.py:75-90 with temperature and alpha args), then sends its logits back
+to guide the clients' next local phase.
+
+TPU-native: feature/logit exchange is array transfer; both training phases
+are jitted scans. The client-side distillation term uses the server logits
+from the previous round (zeros in round 0, matching the reference warm-up).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Pytree = Any
+
+
+def kl_loss(student_logits, teacher_logits, temperature: float):
+    """T²·KL(softmax(teacher/T) || log_softmax(student/T)) (utils.py:75-90)."""
+    t = temperature
+    p_teacher = jax.nn.softmax(teacher_logits / t, axis=-1)
+    log_p_teacher = jax.nn.log_softmax(teacher_logits / t, axis=-1)
+    log_p_student = jax.nn.log_softmax(student_logits / t, axis=-1)
+    return (t * t) * jnp.sum(p_teacher * (log_p_teacher - log_p_student), axis=-1)
+
+
+@dataclasses.dataclass
+class FedGKT:
+    client_module: Any  # ResNetGKTClient
+    server_module: Any  # ResNetGKTServer
+    client_opt: optax.GradientTransformation
+    server_opt: optax.GradientTransformation
+    temperature: float = 3.0
+    alpha: float = 1.0  # distillation weight
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray):
+        k1, k2 = jax.random.split(rng)
+        cvars = self.client_module.init({"params": k1}, sample_x, train=False)
+        feats, _ = self.client_module.apply(cvars, sample_x, train=False)
+        svars = self.server_module.init({"params": k2}, feats, train=False)
+        return dict(cvars), dict(svars)
+
+    # ---- client phase: local CE + KL against server logits ----------------
+
+    def client_train(self, cvars: Pytree, batches: dict, server_logits: jnp.ndarray,
+                     epochs: int, rng: jax.Array):
+        """batches: [S, B, ...] stack; server_logits: [S, B, C] from last round.
+        Returns (new cvars, features [S,B,H,W,F], client logits [S,B,C])."""
+        opt_state = self.client_opt.init(cvars["params"])
+        model_state = {k: v for k, v in cvars.items() if k != "params"}
+
+        def loss_fn(params, state, batch, s_logits):
+            out = self.client_module.apply(
+                {"params": params, **state}, batch["x"], train=True,
+                mutable=list(state.keys()),
+            )
+            (feats, logits), new_state = out
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+            kl = kl_loss(logits, s_logits, self.temperature)
+            m = batch["mask"]
+            loss = jnp.sum((ce + self.alpha * kl) * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return loss, new_state
+
+        def epoch(carry, _):
+            params, state, opt_state = carry
+
+            def step(carry, inp):
+                params, state, opt_state = carry
+                batch, s_logits = inp
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, state, batch, s_logits
+                )
+                updates, opt_state = self.client_opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), new_state, opt_state), loss
+
+            (params, state, opt_state), losses = jax.lax.scan(
+                step, (params, state, opt_state), (batches, server_logits)
+            )
+            return (params, state, opt_state), losses.mean()
+
+        (params, state, opt_state), _ = jax.lax.scan(
+            epoch, (cvars["params"], model_state, opt_state), None, length=epochs
+        )
+        new_cvars = {"params": params, **state}
+
+        # extraction pass (GKTClientTrainer.train returns feature/logit dicts)
+        def extract(batch):
+            feats, logits = self.client_module.apply(new_cvars, batch["x"], train=False)
+            return feats, logits
+
+        feats, logits = jax.vmap(extract)(batches)
+        return new_cvars, feats, logits
+
+    # ---- server phase: train on uploaded features -------------------------
+
+    def server_train(self, svars: Pytree, feats, client_logits, labels, masks,
+                     epochs: int):
+        """feats/client_logits/labels/masks: stacked [N_batches, B, ...] from
+        all clients (GKTServerTrainer.train_and_eval). Returns (new svars,
+        per-batch server logits for the feedback path)."""
+        opt_state = self.server_opt.init(svars["params"])
+        model_state = {k: v for k, v in svars.items() if k != "params"}
+
+        def loss_fn(params, state, f, cl, y, m):
+            out = self.server_module.apply(
+                {"params": params, **state}, f, train=True, mutable=list(state.keys())
+            )
+            logits, new_state = out
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            kl = kl_loss(logits, cl, self.temperature)
+            loss = jnp.sum((ce + self.alpha * kl) * m) / jnp.maximum(jnp.sum(m), 1.0)
+            return loss, new_state
+
+        def epoch(carry, _):
+            params, state, opt_state = carry
+
+            def step(carry, inp):
+                params, state, opt_state = carry
+                f, cl, y, m = inp
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, state, f, cl, y, m
+                )
+                updates, opt_state = self.server_opt.update(grads, opt_state, params)
+                return (optax.apply_updates(params, updates), new_state, opt_state), loss
+
+            (params, state, opt_state), losses = jax.lax.scan(
+                step, (params, state, opt_state), (feats, client_logits, labels, masks)
+            )
+            return (params, state, opt_state), losses.mean()
+
+        (params, state, opt_state), _ = jax.lax.scan(
+            epoch, (svars["params"], model_state, opt_state), None, length=epochs
+        )
+        new_svars = {"params": params, **state}
+
+        def feedback(f):
+            return self.server_module.apply(new_svars, f, train=False)
+
+        server_logits = jax.vmap(feedback)(feats)
+        return new_svars, server_logits

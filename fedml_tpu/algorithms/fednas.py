@@ -1,0 +1,126 @@
+"""Federated NAS (FedNAS): clients run DARTS bilevel search; the server
+averages both weights and architecture parameters.
+
+Reference: fedml_api/distributed/fednas/ — FedNASTrainer.search:34 alternates
+the architecture step (architect.py:13, 2nd-order approx optional) with the
+weight step per batch; FedNASAggregator.py:71-113 averages weights AND α;
+record_model_global_architecture:173 decodes the genotype each round.
+
+Here the bilevel alternation is a jitted scan over (train, val) batch pairs:
+the α step takes the gradient of the *validation* loss w.r.t. the ``arch``
+collection (first-order DARTS; the reference's default unrolled=False path),
+the weight step the training loss w.r.t. ``params``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms.base import Aggregator
+from fedml_tpu.core import tree as treelib
+from fedml_tpu.models.darts import DARTSNetwork, decode_genotype
+
+Pytree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class FedNASTrainer:
+    network: DARTSNetwork
+    w_opt: optax.GradientTransformation
+    arch_opt: optax.GradientTransformation
+    epochs: int = 1
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray) -> Pytree:
+        return dict(self.network.init({"params": rng}, sample_x, train=False))
+
+    def _loss(self, params, arch, state, batch):
+        out, new_state = self.network.apply(
+            {"params": params, "arch": arch, **state}, batch["x"], train=True,
+            mutable=[k for k in list(state.keys()) + []] or ["batch_stats"],
+        )
+        ce = optax.softmax_cross_entropy_with_integer_labels(out, batch["y"])
+        m = batch["mask"]
+        return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0), new_state
+
+    def search_step(self, variables: Pytree, opt_states, train_batch, val_batch):
+        """One bilevel alternation (FedNASTrainer.local_search:82-127)."""
+        params, arch = variables["params"], variables["arch"]
+        state = {k: v for k, v in variables.items() if k not in ("params", "arch")}
+        w_opt_state, a_opt_state = opt_states
+
+        # α step on validation loss (architect.step, first-order)
+        (val_loss, _), a_grads = jax.value_and_grad(
+            lambda a: self._loss(params, a, state, val_batch), has_aux=True
+        )(arch)
+        a_updates, a_opt_state = self.arch_opt.update(a_grads, a_opt_state, arch)
+        arch = optax.apply_updates(arch, a_updates)
+
+        # weight step on training loss
+        (train_loss, new_state), w_grads = jax.value_and_grad(
+            lambda p: self._loss(p, arch, state, train_batch), has_aux=True
+        )(params)
+        w_updates, w_opt_state = self.w_opt.update(w_grads, w_opt_state, params)
+        params = optax.apply_updates(params, w_updates)
+
+        return (
+            {"params": params, "arch": arch, **new_state},
+            (w_opt_state, a_opt_state),
+            {"train_loss": train_loss, "val_loss": val_loss},
+        )
+
+    def local_search(self, global_variables: Pytree, train_batches, val_batches, rng):
+        """K epochs of alternating search as one scan — the FedNAS client
+        round. val_batches must have the same leading steps axis."""
+        opt_states = (
+            self.w_opt.init(global_variables["params"]),
+            self.arch_opt.init(global_variables["arch"]),
+        )
+
+        def epoch(carry, _):
+            variables, opt_states = carry
+
+            def step(carry, inp):
+                variables, opt_states = carry
+                tb, vb = inp
+                variables, opt_states, losses = self.search_step(variables, opt_states, tb, vb)
+                return (variables, opt_states), losses["train_loss"]
+
+            (variables, opt_states), losses = jax.lax.scan(
+                step, (variables, opt_states), (train_batches, val_batches)
+            )
+            return (variables, opt_states), losses.mean()
+
+        (variables, _), epoch_losses = jax.lax.scan(
+            epoch, (global_variables, opt_states), None, length=self.epochs
+        )
+        return variables, {"train_loss": epoch_losses[-1]}
+
+
+def fednas_aggregator() -> Aggregator:
+    """Weighted-average weights AND α (FedNASAggregator.py:71-113); metrics
+    include the decoded genotype via host callback-free argmax (decode happens
+    host-side in the driver)."""
+
+    def init_state(global_variables):
+        return ()
+
+    def aggregate(global_variables, stacked, weights, state, rng):
+        return treelib.tree_weighted_mean(stacked, weights), state, {}
+
+    return Aggregator(init_state, aggregate, name="fednas")
+
+
+def global_genotype(variables: Pytree):
+    """Decode the current global architecture (record_model_global_
+    architecture:173)."""
+    import numpy as np
+
+    return decode_genotype(
+        np.asarray(variables["arch"]["alphas_normal"]),
+        np.asarray(variables["arch"]["alphas_reduce"]),
+    )

@@ -1,0 +1,135 @@
+"""FedNova: normalized averaging for heterogeneous local work.
+
+Reference: fedml_api/standalone/fednova/fednova.py:10-154 (``FedNova``
+optimizer: per-step cum_grad accumulation, local normalizing vector a_i
+recurrences for momentum/proximal variants) + fednova_trainer.py:97-125
+(server aggregates normalized gradients scaled by tau_eff).
+
+Math carried over exactly:
+- client runs tau_i local steps; cum_grad_i = x_global − x_i (the delta)
+- a_i: plain SGD → tau_i; momentum m → Σ_t (1−m^t)/(1−m) via the counter
+  recurrence; proximal ημ → a ← a(1−ημ)+1 per step
+- tau_eff = Σ_i p_i·a_i (p_i = n_i/n; local_steps instead of a_i when μ≠0)
+- x' = x − tau_eff · Σ_i p_i · cum_grad_i / a_i
+
+The client optimizer is an optax transformation replicating the reference's
+update order (weight decay → momentum buffer → proximal term → step), so
+momentum composes with μ exactly as in fednova.py:112-126.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms.base import Aggregator
+from fedml_tpu.core import tree as treelib
+
+
+class FedNovaState(NamedTuple):
+    momentum_buf: optax.Params
+    old_init: optax.Params
+
+
+def fednova_optimizer(
+    lr: float,
+    momentum: float = 0.0,
+    mu: float = 0.0,
+    dampening: float = 0.0,
+    nesterov: bool = False,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Client-side FedNova SGD (reference fednova.py:79-154 step())."""
+
+    def init(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return FedNovaState(momentum_buf=zeros, old_init=params)
+
+    def update(grads, state, params):
+        d = grads
+        if weight_decay:
+            d = jax.tree.map(lambda g, p: g + weight_decay * p, d, params)
+        if momentum:
+            # first step seeds the buffer with d (reference :115-118)
+            def _buf(buf, g):
+                return momentum * buf + (1.0 - dampening) * g
+
+            new_buf = jax.tree.map(_buf, state.momentum_buf, d)
+            if nesterov:
+                d = jax.tree.map(lambda g, b: g + momentum * b, d, new_buf)
+            else:
+                d = new_buf
+        else:
+            new_buf = state.momentum_buf
+        if mu:
+            d = jax.tree.map(
+                lambda g, p, o: g + mu * (p - o), d, params, state.old_init
+            )
+        updates = jax.tree.map(lambda g: -lr * g, d)
+        return updates, FedNovaState(momentum_buf=new_buf, old_init=state.old_init)
+
+    return optax.GradientTransformation(init, update)
+
+
+def normalizing_vector(tau, momentum: float, etamu: float, max_tau: int):
+    """a_i for tau local steps (reference fednova.py:139-151 recurrences).
+    ``tau`` may be a traced per-client array; recursion runs to ``max_tau``
+    with masking so it stays jit-friendly."""
+
+    def body(t, carry):
+        counter, a = carry
+        active = (t < tau).astype(jnp.float32)
+        if momentum != 0.0:
+            counter = jnp.where(active > 0, counter * momentum + 1.0, counter)
+            a = a + active * counter
+        if etamu != 0.0:
+            a = jnp.where(active > 0, a * (1.0 - etamu) + 1.0, a)
+        if momentum == 0.0 and etamu == 0.0:
+            a = a + active
+        return counter, a
+
+    shape = jnp.shape(tau)
+    init = (jnp.zeros(shape, jnp.float32), jnp.zeros(shape, jnp.float32))
+    _, a = jax.lax.fori_loop(0, max_tau, body, init)
+    return a
+
+
+def fednova_aggregator(
+    client_lr: float,
+    momentum: float = 0.0,
+    mu: float = 0.0,
+    batch_size: int = 32,
+    epochs: int = 1,
+    max_client_samples: int = 1 << 20,
+) -> Aggregator:
+    etamu = client_lr * mu
+    max_tau = epochs * max(1, -(-max_client_samples // batch_size))
+
+    def init_state(global_variables):
+        return ()
+
+    def aggregate(global_variables, stacked, weights, state, rng):
+        # per-client effective local steps from true sample counts
+        tau = epochs * jnp.ceil(jnp.maximum(weights, 1.0) / batch_size)
+        a = normalizing_vector(tau, momentum, etamu, max_tau)  # [C]
+        p = weights / jnp.maximum(jnp.sum(weights), 1e-12)  # [C]
+        tau_eff = jnp.sum(p * (tau if mu != 0.0 else a))
+
+        gp = global_variables["params"]
+        coeff = tau_eff * p / jnp.maximum(a, 1e-12)  # [C]
+
+        def _combine(g_leaf, s_leaf):
+            delta = g_leaf[None] - s_leaf  # [C, ...] cum_grad
+            cb = coeff.reshape((-1,) + (1,) * (delta.ndim - 1))
+            return g_leaf - jnp.sum(cb * delta, axis=0)
+
+        new_params = jax.tree.map(_combine, gp, stacked["params"])
+        # aux collections (BN stats): plain weighted average
+        aux = {k: v for k, v in stacked.items() if k != "params"}
+        new_aux = treelib.tree_weighted_mean(aux, weights) if aux else {}
+        return {"params": new_params, **new_aux}, state, {"tau_eff": tau_eff}
+
+    return Aggregator(init_state, aggregate, name="fednova")

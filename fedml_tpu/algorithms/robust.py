@@ -1,0 +1,139 @@
+"""Byzantine-robust aggregation.
+
+Reference: fedml_core/robustness/robust_aggregation.py — norm-difference
+clipping of client deltas (:38-49), weak-DP gaussian noise (:51-55),
+coordinate-wise median (:57-89), with BN statistics excluded from the
+vectorized statistics (:4-9, 28-29); wired into FedAvg by
+fedml_api/distributed/fedavg_robust/FedAvgRobustAggregator.py:176-206
+(clip-then-noise defense pipeline).
+
+All defenses are pure functions over the stacked client axis — the reference's
+per-client Python loops become one vectorized op. Additional defenses
+(trimmed mean, Krum) are standard extensions that fall out of the same
+stacked representation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.base import Aggregator
+from fedml_tpu.core import tree as treelib
+
+Pytree = Any
+
+
+def _is_norm_stat(path: str) -> bool:
+    """BatchNorm statistics filter (robust_aggregation.py:28-29 skips
+    num_batches_tracked; we exclude the whole batch_stats collection)."""
+    return "batch_stats" in path
+
+
+def clip_deltas(global_params: Pytree, stacked: Pytree, norm_bound: float) -> Pytree:
+    """Norm-difference clipping (robust_aggregation.py:38-49): scale each
+    client's delta so its L2 norm (over non-BN leaves) is <= norm_bound."""
+
+    def _client_norm(client_tree):
+        vec = treelib.tree_vectorize(client_tree, exclude=_is_norm_stat)
+        return jnp.linalg.norm(vec)
+
+    deltas = jax.tree.map(lambda s, g: s - g[None], stacked, global_params)
+    norms = jax.vmap(lambda i: _client_norm(jax.tree.map(lambda d: d[i], deltas)))(
+        jnp.arange(jax.tree_util.tree_leaves(stacked)[0].shape[0])
+    )
+    scale = jnp.minimum(1.0, norm_bound / jnp.maximum(norms, 1e-12))  # [C]
+
+    def _apply(d_leaf, g_leaf):
+        sb = scale.reshape((-1,) + (1,) * (d_leaf.ndim - 1))
+        return g_leaf[None] + d_leaf * sb
+
+    return jax.tree.map(_apply, deltas, global_params)
+
+
+def add_weak_dp_noise(tree: Pytree, stddev: float, rng: jax.Array) -> Pytree:
+    """Weak differential privacy: gaussian noise on the aggregate
+    (robust_aggregation.py:51-55)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        leaf + jax.random.normal(k, leaf.shape, leaf.dtype) * stddev
+        if jnp.issubdtype(leaf.dtype, jnp.floating)
+        else leaf
+        for leaf, k in zip(leaves, keys)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+def coordinate_median(stacked: Pytree) -> Pytree:
+    """Coordinate-wise median over the client axis
+    (robust_aggregation.py:57-89)."""
+    return jax.tree.map(lambda s: jnp.median(s, axis=0).astype(s.dtype), stacked)
+
+
+def trimmed_mean(stacked: Pytree, trim_ratio: float = 0.1) -> Pytree:
+    """Coordinate-wise trimmed mean: drop the k highest/lowest per coordinate."""
+
+    def _tm(s):
+        c = s.shape[0]
+        k = int(trim_ratio * c)
+        srt = jnp.sort(s, axis=0)
+        kept = srt[k : c - k] if c - 2 * k > 0 else srt
+        return jnp.mean(kept, axis=0).astype(s.dtype)
+
+    return jax.tree.map(_tm, stacked)
+
+
+def krum_select(stacked: Pytree, num_byzantine: int = 1) -> jnp.ndarray:
+    """Krum: index of the client whose summed distance to its closest
+    C−f−2 neighbors is minimal. Returns the selected client index."""
+    mat = jax.vmap(lambda i: treelib.tree_vectorize(
+        jax.tree.map(lambda s: s[i], stacked), exclude=_is_norm_stat
+    ))(jnp.arange(jax.tree_util.tree_leaves(stacked)[0].shape[0]))  # [C, D]
+    d2 = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)  # [C, C]
+    C = mat.shape[0]
+    closest = C - num_byzantine - 2
+    closest = max(closest, 1)
+    d2 = d2 + jnp.eye(C) * jnp.inf  # exclude self
+    scores = jnp.sum(jnp.sort(d2, axis=1)[:, :closest], axis=1)
+    return jnp.argmin(scores)
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustConfig:
+    """Defense pipeline flags (FedAvgRobustAggregator defense_type args)."""
+
+    norm_bound: float = 0.0  # >0 enables clipping
+    stddev: float = 0.0  # >0 enables weak-DP noise
+    rule: str = "mean"  # mean | median | trimmed_mean | krum
+    trim_ratio: float = 0.1
+    num_byzantine: int = 1
+
+
+def robust_aggregator(config: RobustConfig) -> Aggregator:
+    """Clip → combine (mean/median/trimmed/krum) → noise, the reference
+    pipeline (FedAvgRobustAggregator.py:176-206) as one jitted function."""
+
+    def init_state(global_variables):
+        return ()
+
+    def aggregate(global_variables, stacked, weights, state, rng):
+        if config.norm_bound > 0:
+            stacked = clip_deltas(global_variables, stacked, config.norm_bound)
+        if config.rule == "median":
+            out = coordinate_median(stacked)
+        elif config.rule == "trimmed_mean":
+            out = trimmed_mean(stacked, config.trim_ratio)
+        elif config.rule == "krum":
+            idx = krum_select(stacked, config.num_byzantine)
+            out = jax.tree.map(lambda s: s[idx], stacked)
+        else:
+            out = treelib.tree_weighted_mean(stacked, weights)
+        if config.stddev > 0:
+            out = add_weak_dp_noise(out, config.stddev, rng)
+        return out, state, {}
+
+    return Aggregator(init_state, aggregate, name=f"robust-{config.rule}")

@@ -1,0 +1,128 @@
+"""SplitNN: model split at a cut layer between client and server.
+
+Reference: fedml_api/distributed/split_nn/ — client computes activations
+(client.py:24-30 forward_pass), sends them; server finishes the forward,
+computes loss, backprops and returns ``acts.grad`` (server.py:40-60); clients
+take turns in a relay ring (server.py:62-72 active-node rotation).
+
+TPU-native: the activation/gradient exchange is an explicit ``jax.vjp``
+boundary — the same two-program structure, jittable end to end. In
+simulation both halves run in one program; over the comm layer the
+activation/grad arrays are the wire payloads (never pickled modules).
+This is 2-stage pipeline parallelism; the cut generalizes to a mesh axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+Pytree = Any
+
+
+@dataclasses.dataclass
+class SplitNN:
+    """client_module: x -> activations; server_module: activations -> logits."""
+
+    client_module: Any
+    server_module: Any
+    client_opt: optax.GradientTransformation
+    server_opt: optax.GradientTransformation
+
+    def init(self, rng: jax.Array, sample_x: jnp.ndarray):
+        k1, k2 = jax.random.split(rng)
+        cvars = self.client_module.init({"params": k1, "dropout": k1}, sample_x, train=False)
+        acts = self.client_module.apply(cvars, sample_x, train=False)
+        svars = self.server_module.init({"params": k2, "dropout": k2}, acts, train=False)
+        return dict(cvars), dict(svars)
+
+    def train_step(self, cvars: Pytree, svars: Pytree, c_opt_state, s_opt_state,
+                   batch: dict[str, jnp.ndarray], rng: jax.Array):
+        """One split step with the explicit activation/grad boundary."""
+        x, y, mask = batch["x"], batch["y"], batch["mask"]
+
+        # --- client forward (client.py:24-30); vjp captures the backward ---
+        def client_fwd(cp):
+            return self.client_module.apply({**cvars, "params": cp}, x, train=True,
+                                            rngs={"dropout": rng})
+
+        acts, client_vjp = jax.vjp(client_fwd, cvars["params"])
+
+        # --- server forward/backward (server.py:40-60) ---
+        def server_loss(sp, acts_in):
+            logits = self.server_module.apply({**svars, "params": sp}, acts_in,
+                                              train=True, rngs={"dropout": rng})
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        (loss, (s_grads, acts_grad)) = (
+            server_loss(svars["params"], acts),
+            jax.grad(server_loss, argnums=(0, 1))(svars["params"], acts),
+        )
+        s_updates, s_opt_state = self.server_opt.update(s_grads, s_opt_state, svars["params"])
+        new_sp = optax.apply_updates(svars["params"], s_updates)
+
+        # --- grads cross back to the client (client.py:32-34) ---
+        (c_grads,) = client_vjp(acts_grad)
+        c_updates, c_opt_state = self.client_opt.update(c_grads, c_opt_state, cvars["params"])
+        new_cp = optax.apply_updates(cvars["params"], c_updates)
+
+        return ({**cvars, "params": new_cp}, {**svars, "params": new_sp},
+                c_opt_state, s_opt_state, loss)
+
+
+def run_splitnn_relay(
+    split: SplitNN,
+    client_batches: list[dict[str, jnp.ndarray]],
+    epochs: int,
+    rng: jax.Array,
+):
+    """Relay training: clients take turns against the shared server half
+    (server.py:62-72 rotation). ``client_batches[i]`` is client i's
+    [S, B, ...] batch stack. Client halves are per-client; the server half is
+    shared state across the relay."""
+    sample_x = jax.tree.map(lambda v: v[0], client_batches[0])["x"]
+    cvars0, svars = split.init(rng, sample_x)
+    cvars = [jax.tree.map(jnp.copy, cvars0) for _ in client_batches]
+    s_opt_state = split.server_opt.init(svars["params"])
+
+    @jax.jit
+    def train_client(cv, sv, s_opt, batches, key):
+        c_opt = split.client_opt.init(cv["params"])
+
+        def step(carry, batch):
+            cv, sv, c_opt, s_opt, key = carry
+            key, sub = jax.random.split(key)
+            cv, sv, c_opt, s_opt, loss = split.train_step(cv, sv, c_opt, s_opt, batch, sub)
+            return (cv, sv, c_opt, s_opt, key), loss
+
+        (cv, sv, _, s_opt, _), losses = jax.lax.scan(
+            step, (cv, sv, c_opt, s_opt, key), batches
+        )
+        return cv, sv, s_opt, losses.mean()
+
+    losses = []
+    for _ in range(epochs):
+        for ci, batches in enumerate(client_batches):  # relay ring
+            rng, sub = jax.random.split(rng)
+            cvars[ci], svars, s_opt_state, loss = train_client(
+                cvars[ci], svars, s_opt_state, batches, sub
+            )
+            losses.append(float(loss))
+    return cvars, svars, losses
+
+
+def splitnn_eval(split: SplitNN, cvars, svars, batches):
+    logits_correct = 0.0
+    total = 0.0
+    for b in range(batches["x"].shape[0]):
+        x, y, m = batches["x"][b], batches["y"][b], batches["mask"][b]
+        acts = split.client_module.apply(cvars, x, train=False)
+        logits = split.server_module.apply(svars, acts, train=False)
+        logits_correct += float(jnp.sum((jnp.argmax(logits, -1) == y) * m))
+        total += float(jnp.sum(m))
+    return logits_correct / max(total, 1.0)

@@ -1,0 +1,166 @@
+"""DARTS search space for federated NAS.
+
+Reference: fedml_api/model/cv/darts/ — ``model_search.py`` (mixed ops over a
+cell DAG, 306 LoC), ``operations.py`` (candidate op set), ``genotypes.py``
+(genotype encode/decode), ``architect.py:13`` (bilevel architecture step).
+
+Design: architecture parameters α live in their own ``arch`` variable
+collection, separate from ``params`` — the FedNAS server averages both
+(FedNASAggregator.py:71-113 averages weights AND alphas), and the client's
+bilevel search alternates grads w.r.t. the two collections. The mixed op is a
+softmax(α)-weighted sum of candidate branches — all branches execute (dense,
+MXU-friendly); discretization happens only at genotype decode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PRIMITIVES = ("none", "skip_connect", "conv_3x3", "sep_conv_3x3", "avg_pool_3x3", "max_pool_3x3")
+
+
+class _Op(nn.Module):
+    kind: str
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        k = self.kind
+        if k == "none":
+            if self.stride > 1:
+                x = x[:, :: self.stride, :: self.stride, :]
+            return jnp.zeros_like(x) if x.shape[-1] == self.channels else jnp.zeros(
+                x.shape[:-1] + (self.channels,), x.dtype
+            )
+        if k == "skip_connect":
+            if self.stride == 1 and x.shape[-1] == self.channels:
+                return x
+            # factorized reduce
+            return nn.Conv(self.channels, (1, 1), strides=self.stride, use_bias=False)(x)
+        if k == "conv_3x3":
+            h = nn.relu(x)
+            h = nn.Conv(self.channels, (3, 3), strides=self.stride, padding="SAME", use_bias=False)(h)
+            return nn.BatchNorm(use_running_average=not train)(h)
+        if k == "sep_conv_3x3":
+            h = nn.relu(x)
+            c_in = h.shape[-1]
+            h = nn.Conv(c_in, (3, 3), strides=self.stride, padding="SAME",
+                        feature_group_count=c_in, use_bias=False)(h)
+            h = nn.Conv(self.channels, (1, 1), use_bias=False)(h)
+            return nn.BatchNorm(use_running_average=not train)(h)
+        if k in ("avg_pool_3x3", "max_pool_3x3"):
+            pool = nn.avg_pool if k.startswith("avg") else nn.max_pool
+            h = pool(x, (3, 3), strides=(self.stride, self.stride), padding="SAME")
+            if h.shape[-1] != self.channels:
+                h = nn.Conv(self.channels, (1, 1), use_bias=False)(h)
+            return h
+        raise ValueError(k)
+
+
+class MixedOp(nn.Module):
+    channels: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = False):
+        outs = [_Op(p, self.channels, self.stride)(x, train=train) for p in PRIMITIVES]
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class Cell(nn.Module):
+    """DAG cell: ``steps`` intermediate nodes, each summing mixed ops over all
+    previous states (model_search.py Cell)."""
+
+    channels: int
+    steps: int = 3
+    reduction: bool = False
+
+    @nn.compact
+    def __call__(self, s0, s1, alphas, train: bool = False):
+        s0 = nn.Conv(self.channels, (1, 1), use_bias=False)(nn.relu(s0))
+        if s1.shape[1] != s0.shape[1]:  # previous cell reduced
+            s0 = nn.avg_pool(s0, (2, 2), strides=(2, 2))
+        s1 = nn.Conv(self.channels, (1, 1), use_bias=False)(nn.relu(s1))
+        states = [s0, s1]
+        offset = 0
+        weights = jax.nn.softmax(alphas, axis=-1)
+        for i in range(self.steps):
+            acc = None
+            for j, h in enumerate(states):
+                stride = 2 if self.reduction and j < 2 else 1
+                out = MixedOp(self.channels, stride)(h, weights[offset + j], train=train)
+                acc = out if acc is None else acc + out
+            offset += len(states)
+            states.append(acc)
+        return jnp.concatenate(states[-self.steps:], axis=-1)
+
+
+def num_edges(steps: int) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class DARTSNetwork(nn.Module):
+    """Searchable network (model_search.py Network): stem → cells → classifier.
+    α lives in the ``arch`` collection: ``arch/alphas_normal`` and
+    ``arch/alphas_reduce`` [E, |PRIMITIVES|]."""
+
+    num_classes: int = 10
+    channels: int = 8
+    layers: int = 4
+    steps: int = 3
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        E = num_edges(self.steps)
+        a_n = self.variable("arch", "alphas_normal",
+                            lambda: 1e-3 * jax.random.normal(self.make_rng("params"), (E, len(PRIMITIVES))))
+        a_r = self.variable("arch", "alphas_reduce",
+                            lambda: 1e-3 * jax.random.normal(self.make_rng("params"), (E, len(PRIMITIVES))))
+        h = nn.Conv(self.channels * 3, (3, 3), padding="SAME", use_bias=False)(x.astype(jnp.float32))
+        h = nn.BatchNorm(use_running_average=not train)(h)
+        s0 = s1 = h
+        c = self.channels
+        for layer in range(self.layers):
+            reduction = layer in (self.layers // 3, 2 * self.layers // 3) and self.layers >= 3
+            if reduction:
+                c *= 2
+            cell = Cell(c, self.steps, reduction)
+            s0, s1 = s1, cell(s0, s1, a_r.value if reduction else a_n.value, train=train)
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+@dataclasses.dataclass
+class Genotype:
+    normal: list[tuple[str, int]]
+    reduce: list[tuple[str, int]]
+
+
+def decode_genotype(alphas_normal: np.ndarray, alphas_reduce: np.ndarray, steps: int = 3) -> Genotype:
+    """Argmax decode (genotypes.py / FedNASAggregator.record_model_global_
+    architecture:173): per node keep the 2 strongest non-'none' incoming edges."""
+
+    def _decode(alphas):
+        gene = []
+        offset = 0
+        none_idx = PRIMITIVES.index("none")
+        w = np.asarray(jax.nn.softmax(jnp.asarray(alphas), axis=-1))
+        for i in range(steps):
+            n_in = 2 + i
+            edges = w[offset : offset + n_in].copy()
+            edges[:, none_idx] = -1
+            strength = edges.max(axis=1)
+            top2 = np.argsort(-strength)[:2]
+            for j in sorted(top2):
+                gene.append((PRIMITIVES[int(np.argmax(edges[j]))], int(j)))
+            offset += n_in
+        return gene
+
+    return Genotype(_decode(alphas_normal), _decode(alphas_reduce))

@@ -21,7 +21,9 @@ class Generator(nn.Module):
             if norm:
                 h = nn.BatchNorm(use_running_average=not train, momentum=0.8)(h)
             h = nn.leaky_relu(h, 0.2)
-        h = nn.tanh(nn.Dense(int(jnp.prod(jnp.asarray(self.img_shape))))(h))
+        import numpy as np
+
+        h = nn.tanh(nn.Dense(int(np.prod(self.img_shape)))(h))
         return h.reshape((h.shape[0],) + self.img_shape)
 
 

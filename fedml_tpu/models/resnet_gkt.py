@@ -1,0 +1,56 @@
+"""Split ResNets for Group Knowledge Transfer.
+
+Reference: fedml_api/model/cv/resnet56_gkt/ — ``resnet8_56`` client (stem +
+one small stage + its own classifier head, also exposing the feature maps)
+and ``resnet56_server`` (takes the client's feature maps, runs the remaining
+stages + classifier). The client uploads (features, logits, labels); the
+server trains on features with CE + bidirectional KL distillation
+(fedgkt/utils.py:75-90 KL_Loss).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.resnet import BasicBlock, _norm
+
+
+class ResNetGKTClient(nn.Module):
+    """Small edge model (resnet8_56 analogue): stem + n blocks @16ch; returns
+    (features [B,H,W,16], logits [B,C])."""
+
+    num_classes: int = 10
+    blocks: int = 1
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm, train)
+        h = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x.astype(jnp.float32))
+        h = nn.relu(norm()(h))
+        for _ in range(self.blocks):
+            h = BasicBlock(16, 1, self.norm)(h, train=train)
+        features = h
+        pooled = jnp.mean(h, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(pooled)
+        return features, logits
+
+
+class ResNetGKTServer(nn.Module):
+    """Large server model (resnet56_server analogue): consumes client feature
+    maps, runs stages 2-3 and the classifier."""
+
+    num_classes: int = 10
+    blocks_per_stage: int = 9
+    norm: str = "bn"
+
+    @nn.compact
+    def __call__(self, features, train: bool = False):
+        h = features.astype(jnp.float32)
+        for stage, filters in enumerate([32, 64]):
+            for block in range(self.blocks_per_stage):
+                stride = 2 if block == 0 else 1
+                h = BasicBlock(filters, stride, self.norm)(h, train=train)
+        h = jnp.mean(h, axis=(1, 2))
+        return nn.Dense(self.num_classes)(h)

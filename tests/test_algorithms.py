@@ -1,0 +1,273 @@
+"""Algorithm-layer tests: aggregators, FedNova math, robust defenses,
+FedProx μ, gossip mixing, MPC field math, scheduler."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.algorithms.base import fedavg_aggregator
+from fedml_tpu.algorithms.decentralized import mix, run_online_gossip
+from fedml_tpu.algorithms.fednova import (
+    fednova_aggregator,
+    fednova_optimizer,
+    normalizing_vector,
+)
+from fedml_tpu.algorithms.fedopt import fedopt_aggregator, server_optimizer
+from fedml_tpu.algorithms.fedprox import fedprox_trainer, straggler_epochs
+from fedml_tpu.algorithms.robust import (
+    RobustConfig,
+    clip_deltas,
+    coordinate_median,
+    krum_select,
+    robust_aggregator,
+    trimmed_mean,
+)
+from fedml_tpu.algorithms import turboaggregate as mpc
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.core.tree import tree_stack, tree_weighted_mean
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.schedule.scheduler import dp_schedule, lpt_schedule
+from fedml_tpu.sim.engine import FedSim, SimConfig
+from fedml_tpu.topology.topology import (
+    SymmetricTopologyManager,
+    AsymmetricTopologyManager,
+    ring_topology,
+)
+
+
+def _stacked_params(vals):
+    return {"params": {"w": jnp.asarray(vals, jnp.float32)}}
+
+
+def test_fedopt_sgd_lr1_equals_fedavg():
+    """FedOpt with SGD(lr=1, m=0) must reduce exactly to FedAvg."""
+    global_vars = _stacked_params([1.0, 2.0])
+    stacked = {"params": {"w": jnp.asarray([[2.0, 2.0], [0.0, 4.0]])}}
+    weights = jnp.asarray([1.0, 1.0])
+    agg = fedopt_aggregator(server_optimizer("sgd", server_lr=1.0, server_momentum=0.0))
+    st = agg.init_state(global_vars)
+    out, _, _ = agg.aggregate(global_vars, stacked, weights, st, jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), [1.0, 3.0], atol=1e-6)
+
+
+def test_fedopt_adam_moves_toward_avg():
+    global_vars = _stacked_params([0.0, 0.0])
+    stacked = {"params": {"w": jnp.asarray([[1.0, -1.0], [1.0, -1.0]])}}
+    weights = jnp.asarray([1.0, 1.0])
+    agg = fedopt_aggregator(server_optimizer("adam", server_lr=0.1))
+    st = agg.init_state(global_vars)
+    out, _, _ = agg.aggregate(global_vars, stacked, weights, st, jax.random.key(0))
+    w = np.asarray(out["params"]["w"])
+    assert w[0] > 0 and w[1] < 0
+
+
+def test_fednova_normalizing_vector_plain_sgd():
+    a = normalizing_vector(jnp.asarray([3.0, 5.0]), 0.0, 0.0, 8)
+    np.testing.assert_allclose(np.asarray(a), [3.0, 5.0])
+
+
+def test_fednova_normalizing_vector_momentum():
+    # m=0.9: c_t=(1-0.9^t)/0.1, a = sum_t c_t
+    m = 0.9
+    tau = 4
+    cs = [(1 - m ** t) / (1 - m) for t in range(1, tau + 1)]
+    a = normalizing_vector(jnp.asarray([float(tau)]), m, 0.0, 10)
+    np.testing.assert_allclose(np.asarray(a), [sum(cs)], rtol=1e-5)
+
+
+def test_fednova_equals_fedavg_for_homogeneous_plain_sgd():
+    """Equal client sample counts + plain SGD: FedNova == FedAvg."""
+    global_vars = _stacked_params([1.0, 1.0])
+    stacked = {"params": {"w": jnp.asarray([[0.0, 2.0], [2.0, 0.0]])}}
+    weights = jnp.asarray([8.0, 8.0])
+    agg = fednova_aggregator(client_lr=0.1, batch_size=4, epochs=1)
+    out, _, m = agg.aggregate(global_vars, stacked, weights, (), jax.random.key(0))
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), [1.0, 1.0], atol=1e-6)
+    assert float(m["tau_eff"]) == pytest.approx(2.0)
+
+
+def test_fednova_optimizer_matches_sgd_when_plain():
+    opt = fednova_optimizer(lr=0.1)
+    ref = optax.sgd(0.1)
+    params = {"w": jnp.asarray([1.0, 2.0])}
+    grads = {"w": jnp.asarray([0.5, -0.5])}
+    s1, s2 = opt.init(params), ref.init(params)
+    u1, _ = opt.update(grads, s1, params)
+    u2, _ = ref.update(grads, s2, params)
+    np.testing.assert_allclose(np.asarray(u1["w"]), np.asarray(u2["w"]), atol=1e-7)
+
+
+def test_clip_deltas_bounds_norms():
+    g = {"w": jnp.zeros(4)}
+    stacked = {"w": jnp.asarray([[10.0, 0, 0, 0], [0.1, 0, 0, 0]])}
+    clipped = clip_deltas(g, stacked, norm_bound=1.0)
+    norms = jnp.linalg.norm(clipped["w"], axis=1)
+    assert float(norms[0]) == pytest.approx(1.0, rel=1e-4)
+    assert float(norms[1]) == pytest.approx(0.1, rel=1e-4)
+
+
+def test_median_resists_outlier():
+    stacked = {"w": jnp.asarray([[1.0], [1.1], [0.9], [100.0], [1.05]])}
+    med = coordinate_median(stacked)
+    assert abs(float(med["w"][0]) - 1.05) < 0.2
+
+
+def test_trimmed_mean_drops_extremes():
+    stacked = {"w": jnp.asarray([[1.0], [1.0], [1.0], [1.0], [-50.0], [60.0]])}
+    tm = trimmed_mean(stacked, trim_ratio=0.2)
+    assert abs(float(tm["w"][0]) - 1.0) < 0.5
+
+
+def test_krum_picks_inlier():
+    stacked = {"params": {"w": jnp.asarray([[1.0, 1.0], [1.1, 0.9], [0.95, 1.05], [50.0, -50.0]])}}
+    idx = krum_select(stacked, num_byzantine=1)
+    assert int(idx) != 3
+
+
+def test_robust_aggregator_pipeline():
+    g = {"params": {"w": jnp.zeros(2)}}
+    stacked = {"params": {"w": jnp.asarray([[1.0, 1.0], [1.0, 1.0], [99.0, -99.0]])}}
+    weights = jnp.ones(3)
+    agg = robust_aggregator(RobustConfig(norm_bound=2.0, stddev=0.0, rule="median"))
+    out, _, _ = agg.aggregate(g, stacked, weights, (), jax.random.key(0))
+    assert float(jnp.abs(out["params"]["w"]).max()) < 2.1
+
+
+def test_fedprox_pulls_toward_global():
+    """Large μ keeps local params near global despite gradient pressure."""
+    train, test = gaussian_blobs(n_clients=4, samples_per_client=32, seed=0)
+    base = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.1), epochs=3
+    )
+    from fedml_tpu.core.trainer import make_local_train
+    from fedml_tpu.sim.cohort import stack_cohort
+
+    batches, w = stack_cohort(train, np.arange(1), 16)
+    batches = jax.tree.map(lambda x: jnp.asarray(x[0]), batches)
+    variables = base.init(jax.random.key(0), jax.tree.map(lambda x: x[0], batches))
+
+    def drift(mu):
+        tr = fedprox_trainer(base, mu)
+        out, _ = jax.jit(make_local_train(tr))(variables, batches, jax.random.key(1))
+        return float(
+            jnp.linalg.norm(
+                out["params"]["Dense_0"]["kernel"] - variables["params"]["Dense_0"]["kernel"]
+            )
+        )
+
+    # lr*mu must stay < 2 for the proximal step to be stable
+    assert drift(10.0) < drift(0.0) * 0.5
+
+
+def test_straggler_epochs():
+    eps = straggler_epochs(3, 100, epochs=5, straggler_frac=0.5, seed=0)
+    assert eps.max() == 5 and eps.min() >= 1 and (eps < 5).sum() > 10
+
+
+def test_topology_row_stochastic():
+    for mgr in (SymmetricTopologyManager(8, 2), AsymmetricTopologyManager(8, 2, 2)):
+        W = mgr.generate_topology()
+        np.testing.assert_allclose(W.sum(axis=1), np.ones(8), atol=1e-6)
+        assert mgr.get_out_neighbor_idx_list(0)
+    W = ring_topology(6)
+    assert W[0, 1] > 0 and W[0, 5] > 0 and W[0, 3] == 0
+
+
+def test_gossip_mix_converges_to_consensus():
+    W = jnp.asarray(ring_topology(8))
+    stacked = {"w": jnp.asarray(np.random.RandomState(0).rand(8, 3), jnp.float32)}
+    x = stacked
+    for _ in range(60):
+        x = mix(x, W)
+    spread = float(jnp.ptp(x["w"], axis=0).max())
+    assert spread < 1e-3
+    # consensus preserves the mean (doubly-stochastic symmetric ring)
+    np.testing.assert_allclose(
+        np.asarray(x["w"].mean(0)), np.asarray(stacked["w"].mean(0)), atol=1e-4
+    )
+
+
+def test_online_gossip_learns():
+    rng = np.random.RandomState(0)
+    T, N, D = 60, 6, 10
+    w_true = rng.randn(D)
+    xs = rng.randn(T, N, D).astype(np.float32)
+    ys = np.sign(xs @ w_true).astype(np.float32)
+    params, regret = run_online_gossip(xs, ys, N, lr=0.3, mode="dsgd")
+    # average per-round loss in the last third lower than the first third
+    assert regret[-1] - regret[2 * T // 3] < regret[T // 3] - regret[0]
+    params2, _ = run_online_gossip(xs, ys, N, lr=0.3, mode="pushsum", time_varying=True)
+    assert np.isfinite(params2).all()
+
+
+def test_mpc_bgw_roundtrip():
+    secret = np.asarray([12345, 67890, 1], dtype=np.int64)
+    shares = mpc.bgw_encode(secret, n_shares=5, threshold=2, seed=0)
+    idx = np.asarray([0, 2, 4])
+    rec = mpc.bgw_decode(shares[idx], idx)
+    np.testing.assert_array_equal(rec, secret)
+
+
+def test_mpc_lcc_roundtrip():
+    data = np.arange(12, dtype=np.int64).reshape(3, 4) + 100
+    shares = mpc.lcc_encode(data, n_workers=7, k_batches=3, t_privacy=1, seed=1)
+    idx = np.arange(5)
+    rec = mpc.lcc_decode(shares[idx], idx, k_batches=3, t_privacy=1)
+    np.testing.assert_array_equal(rec, data)
+
+
+def test_mpc_secure_sum_matches_plain_sum():
+    vecs = [np.random.RandomState(i).randn(6) for i in range(4)]
+    got = mpc.secure_sum(vecs, threshold=1)
+    np.testing.assert_allclose(got, np.sum(vecs, axis=0), atol=1e-3)
+
+
+def test_mpc_additive_shares():
+    s = np.asarray([42, 7], dtype=np.int64)
+    shares = mpc.additive_shares(s, 5, seed=3)
+    np.testing.assert_array_equal(shares.sum(axis=0) % mpc.DEFAULT_PRIME, s)
+
+
+def test_dh_key_agreement():
+    pk_a = mpc.dh_keygen(5, 1234)
+    pk_b = mpc.dh_keygen(5, 5678)
+    assert mpc.dh_shared(pk_b, 1234) == mpc.dh_shared(pk_a, 5678)
+
+
+def test_lpt_schedule_balances():
+    loads = np.asarray([10, 9, 8, 7, 1, 1, 1, 1])
+    assign = lpt_schedule(loads, 4)
+    sums = sorted(sum(loads[i] for i in a) for a in assign)
+    assert sums[-1] <= 11
+
+
+def test_dp_schedule_optimal():
+    loads = np.asarray([4, 3, 3, 2, 2])
+    assign, makespan = dp_schedule(loads, 2)
+    assert makespan == 7.0
+    all_items = sorted(i for a in assign for i in a)
+    assert all_items == list(range(5))
+
+
+def test_fednova_end_to_end_matches_fedavg_curve():
+    """Full sim with FedNova on homogeneous data behaves like FedAvg."""
+    train, test = gaussian_blobs(n_clients=8, samples_per_client=32, seed=5)
+    tr = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=fednova_optimizer(lr=0.2),
+        epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=8, client_num_per_round=8, batch_size=8,
+        comm_round=6, frequency_of_the_test=6,
+    )
+    agg = fednova_aggregator(client_lr=0.2, batch_size=8, epochs=1,
+                             max_client_samples=train.max_client_size())
+    sim = FedSim(tr, train, test, cfg, aggregator=agg)
+    _, hist = sim.run()
+    assert hist[-1]["Test/Acc"] > 0.8

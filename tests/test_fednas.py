@@ -1,0 +1,56 @@
+"""FedNAS / DARTS tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fednas import FedNASTrainer, fednas_aggregator, global_genotype
+from fedml_tpu.core.tree import tree_stack
+from fedml_tpu.models.darts import DARTSNetwork, PRIMITIVES, decode_genotype, num_edges
+
+
+def _toy_batches(S=2, B=4, hw=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "x": jnp.asarray(rng.rand(S, B, hw, hw, 3), jnp.float32),
+        "y": jnp.asarray(rng.randint(0, classes, (S, B))),
+        "mask": jnp.ones((S, B), jnp.float32),
+    }
+
+
+def test_darts_network_forward():
+    net = DARTSNetwork(num_classes=4, channels=4, layers=3, steps=2)
+    x = jnp.ones((2, 8, 8, 3))
+    variables = net.init({"params": jax.random.key(0)}, x, train=False)
+    assert "arch" in variables
+    E = num_edges(2)
+    assert variables["arch"]["alphas_normal"].shape == (E, len(PRIMITIVES))
+    out = net.apply(variables, x, train=False)
+    assert out.shape == (2, 4)
+
+
+def test_fednas_local_search_updates_alpha_and_weights():
+    net = DARTSNetwork(num_classes=4, channels=4, layers=2, steps=2)
+    tr = FedNASTrainer(net, optax.sgd(0.05), optax.adam(3e-3), epochs=1)
+    batches = _toy_batches()
+    variables = tr.init(jax.random.key(0), batches["x"][0])
+    out, metrics = jax.jit(tr.local_search)(variables, batches, batches, jax.random.key(1))
+    da = float(jnp.abs(out["arch"]["alphas_normal"] - variables["arch"]["alphas_normal"]).sum())
+    assert da > 0
+    assert np.isfinite(float(metrics["train_loss"]))
+    # aggregator averages weights and alphas together
+    stacked = tree_stack([out, variables])
+    agg = fednas_aggregator()
+    avg, _, _ = agg.aggregate(variables, stacked, jnp.asarray([1.0, 1.0]), (), jax.random.key(2))
+    mid = 0.5 * (out["arch"]["alphas_normal"] + variables["arch"]["alphas_normal"])
+    np.testing.assert_allclose(np.asarray(avg["arch"]["alphas_normal"]), np.asarray(mid), atol=1e-6)
+
+
+def test_genotype_decode():
+    E = num_edges(3)
+    rng = np.random.RandomState(0)
+    g = decode_genotype(rng.randn(E, len(PRIMITIVES)), rng.randn(E, len(PRIMITIVES)), steps=3)
+    assert len(g.normal) == 6 and len(g.reduce) == 6  # 2 edges per node x 3 nodes
+    for op, j in g.normal:
+        assert op in PRIMITIVES and op != "none"

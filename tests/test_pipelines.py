@@ -1,0 +1,184 @@
+"""Composite-pipeline tests: SplitNN, vertical FL, FedGKT, FedGAN,
+hierarchical FL (incl. the hierarchical == centralized oracle)."""
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.algorithms.fedgan import GANTrainer, fedgan_aggregator, make_gan_local_train
+from fedml_tpu.algorithms.fedgkt import FedGKT, kl_loss
+from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvg, HierConfig, random_group_assignment
+from fedml_tpu.algorithms.splitnn import SplitNN, run_splitnn_relay, splitnn_eval
+from fedml_tpu.algorithms.vertical import PartyModel, run_vfl
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.gan import Discriminator, Generator
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.models.resnet_gkt import ResNetGKTClient, ResNetGKTServer
+from fedml_tpu.sim.cohort import batch_array, stack_cohort
+from fedml_tpu.sim.engine import FedSim, SimConfig
+
+
+class _Bottom(nn.Module):
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.relu(nn.Dense(16)(x.astype(jnp.float32)))
+
+
+class _Top(nn.Module):
+    classes: int = 4
+
+    @nn.compact
+    def __call__(self, acts, train: bool = False):
+        return nn.Dense(self.classes)(acts)
+
+
+def test_splitnn_relay_learns():
+    train, test = gaussian_blobs(n_clients=3, samples_per_client=60, num_classes=4, seed=0)
+    split = SplitNN(_Bottom(), _Top(4), optax.sgd(0.2), optax.sgd(0.2))
+    client_batches = []
+    for c in range(3):
+        stack, _ = stack_cohort(train, np.asarray([c]), batch_size=10)
+        client_batches.append(jax.tree.map(lambda v: jnp.asarray(v[0]), stack))
+    cvars, svars, losses = run_splitnn_relay(split, client_batches, epochs=6, rng=jax.random.key(0))
+    assert losses[-1] < losses[0]
+    test_b = jax.tree.map(jnp.asarray, batch_array(test, 32))
+    acc = splitnn_eval(split, cvars[0], svars, test_b)
+    assert acc > 0.8
+
+
+def test_vfl_two_party_learns():
+    rng = np.random.RandomState(0)
+    n, d = 400, 20
+    x = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d)
+    y = (x @ w > 0).astype(np.int32)
+    # feature partition: party 0 (guest) gets first 12 cols, host gets 8
+    fs = [jnp.asarray(x[:, :12]), jnp.asarray(x[:, 12:])]
+    vfl, pvars, losses = run_vfl(fs, jnp.asarray(y), epochs=8, batch_size=40, lr=0.3)
+    assert losses[-1] < losses[0] * 0.7
+    pred = np.asarray(vfl.predict(pvars, fs)) > 0.5
+    assert (pred == y).mean() > 0.85
+
+
+def test_fedgkt_one_round():
+    train, test = gaussian_blobs(n_clients=2, samples_per_client=24, num_classes=4, seed=1)
+    # reshape flat features into tiny images for the conv models
+    imgs = train.arrays["x"].reshape(-1, 4, 4, 1)
+    gkt = FedGKT(
+        ResNetGKTClient(num_classes=4, blocks=1),
+        ResNetGKTServer(num_classes=4, blocks_per_stage=1),
+        optax.sgd(0.05),
+        optax.sgd(0.05),
+        temperature=2.0,
+    )
+    cvars, svars = gkt.init(jax.random.key(0), jnp.asarray(imgs[:4]))
+
+    S, B = 3, 8
+    batches = {
+        "x": jnp.asarray(imgs[: S * B].reshape(S, B, 4, 4, 1)),
+        "y": jnp.asarray(train.arrays["y"][: S * B].reshape(S, B)),
+        "mask": jnp.ones((S, B), jnp.float32),
+    }
+    zero_logits = jnp.zeros((S, B, 4))
+    cvars, feats, clogits = jax.jit(gkt.client_train, static_argnums=3)(
+        cvars, batches, zero_logits, 2, jax.random.key(1)
+    )
+    assert feats.shape == (S, B, 4, 4, 16)
+    svars, slogits = jax.jit(gkt.server_train, static_argnums=5)(
+        svars, feats, clogits, batches["y"], batches["mask"], 2
+    )
+    assert slogits.shape == (S, B, 4)
+    assert np.isfinite(np.asarray(slogits)).all()
+    # another client round consuming server feedback must also be finite
+    cvars, _, _ = jax.jit(gkt.client_train, static_argnums=3)(
+        cvars, batches, slogits, 1, jax.random.key(2)
+    )
+
+
+def test_kl_loss_zero_when_identical():
+    logits = jnp.asarray([[1.0, 2.0, 3.0]])
+    kl = kl_loss(logits, logits, temperature=3.0)
+    assert float(kl[0]) == pytest.approx(0.0, abs=1e-5)
+
+
+def test_fedgan_federated_round():
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(2, 2, 8, 28, 28, 1).astype(np.float32)  # [C, S, B, ...]
+    data = {
+        "x": jnp.asarray(imgs),
+        "y": jnp.zeros((2, 2, 8), jnp.int32),
+        "mask": jnp.ones((2, 2, 8), jnp.float32),
+    }
+    trainer = GANTrainer(
+        Generator(), Discriminator(), optax.adam(2e-4), optax.adam(2e-4), epochs=1
+    )
+    pair = trainer.init(jax.random.key(0), {"x": jnp.asarray(imgs[0, 0])})
+    local = make_gan_local_train(trainer)
+    locals_, metrics = jax.jit(jax.vmap(local, in_axes=(None, 0, 0)))(
+        pair, data, jax.random.split(jax.random.key(1), 2)
+    )
+    agg = fedgan_aggregator()
+    out, _, _ = agg.aggregate(pair, locals_, jnp.asarray([8.0, 8.0]), (), jax.random.key(2))
+    assert set(out.keys()) == {"generator", "discriminator"}
+    assert np.isfinite(float(metrics["train_loss"][0]))
+
+
+def test_group_assignment_partitions():
+    groups = random_group_assignment(17, 4, seed=0)
+    allc = np.concatenate([groups[g] for g in range(4)])
+    assert sorted(allc.tolist()) == list(range(17))
+
+
+def test_hierarchical_equals_centralized_oracle():
+    """CI-script-fedavg.sh:50-58 invariant: full-batch E=1 hierarchical FL ==
+    centralized GD when global_round x group_round is fixed, for any grouping."""
+    train, test = gaussian_blobs(n_clients=6, samples_per_client=30, seed=2)
+    max_n = train.max_client_size()
+    tr = ClientTrainer(module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.1), epochs=1)
+
+    def run_hier(n_groups, g_rounds, grp_rounds):
+        cfg = SimConfig(
+            client_num_in_total=6, client_num_per_round=6, batch_size=int(max_n),
+            comm_round=1, frequency_of_the_test=10, shuffle_each_round=False,
+        )
+        sim = FedSim(tr, train, test, cfg)
+        hier = HierarchicalFedAvg(sim, HierConfig(n_groups, g_rounds, grp_rounds))
+        variables, _ = hier.run()
+        return variables
+
+    # NOTE: with 1 group, hierarchical == flat FedAvg; equivalence to
+    # centralized needs every round to aggregate over ALL clients, which holds
+    # when each group contains all clients (group_num=1).
+    v1 = run_hier(1, 2, 2)
+
+    from fedml_tpu.core.trainer import make_local_train
+    from fedml_tpu.sim.engine import centralized_train
+
+    cfg = SimConfig(client_num_in_total=6, client_num_per_round=6, batch_size=int(max_n))
+    sim = FedSim(tr, train, test, cfg)
+    cent = sim.init_variables()
+    batches = jax.tree.map(jnp.asarray, batch_array(train.arrays, train.num_samples))
+    step = jax.jit(make_local_train(dataclasses.replace(tr, epochs=1)))
+    for r in range(4):  # 2 global x 2 group rounds
+        cent, _ = step(cent, batches, jax.random.key(9))
+
+    for a, b in zip(jax.tree_util.tree_leaves(v1), jax.tree_util.tree_leaves(cent)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5)
+
+
+def test_hierarchical_multi_group_runs():
+    train, test = gaussian_blobs(n_clients=8, samples_per_client=24, seed=3)
+    tr = ClientTrainer(module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=1)
+    cfg = SimConfig(client_num_in_total=8, client_num_per_round=8, batch_size=8,
+                    comm_round=1, frequency_of_the_test=1)
+    sim = FedSim(tr, train, test, cfg)
+    hier = HierarchicalFedAvg(sim, HierConfig(group_num=3, global_comm_round=2, group_comm_round=2))
+    variables, hist = hier.run()
+    assert len(hist) == 2
+    assert hist[-1]["Test/Acc"] > 0.5
