@@ -1,0 +1,171 @@
+"""Benchmark: FedAvg round throughput, flagship config (ResNet-56, CIFAR-10
+shapes) on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+value = FedAvg rounds/sec (steady state) for 10 clients/round x 1 local epoch
+x 8 steps x batch 32 on ResNet-56 — the reference's cross-silo headline model
+(BASELINE.md cross-silo table) at bench-scale shapes.
+
+vs_baseline = our rounds/sec divided by the same federated round executed by
+the reference implementation stack (PyTorch, this host's CPU — the only
+executable reference here; the reference repo publishes no wall-clock,
+SURVEY §6). The torch number is measured once and cached in .bench_cache.json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+CACHE = Path(__file__).parent / ".bench_cache.json"
+
+CLIENTS = 10
+STEPS = 8
+BATCH = 32
+EPOCHS = 1
+
+
+def bench_jax() -> float:
+    """Rounds/sec of the vectorized engine on the default platform."""
+    import numpy as np
+
+    import jax
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.resnet import resnet56
+    from fedml_tpu.sim.cohort import FederatedArrays
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    rng = np.random.RandomState(0)
+    n_per = STEPS * BATCH
+    n = CLIENTS * n_per
+    x = rng.rand(n, 32, 32, 3).astype(np.float32)
+    y = rng.randint(0, 10, n).astype(np.int32)
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(CLIENTS)}
+    train = FederatedArrays({"x": x, "y": y}, part)
+
+    trainer = ClientTrainer(
+        module=resnet56(class_num=10),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        epochs=EPOCHS,
+    )
+    cfg = SimConfig(
+        client_num_in_total=CLIENTS, client_num_per_round=CLIENTS,
+        batch_size=BATCH, comm_round=1, epochs=EPOCHS,
+        frequency_of_the_test=10_000, shuffle_each_round=False, seed=0,
+    )
+    sim = FedSim(trainer, train, None, cfg)
+
+    from fedml_tpu.core import rng as rnglib
+
+    variables = jax.device_put(sim.init_variables(), sim._rep)
+    server_state = sim.aggregator.init_state(variables)
+    root = rnglib.root_key(0)
+
+    # warmup (compile)
+    variables, server_state, _ = sim.run_round(0, variables, server_state, root)
+    jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
+
+    times = []
+    for r in range(1, 6):
+        t0 = time.perf_counter()
+        variables, server_state, _ = sim.run_round(r, variables, server_state, root)
+        jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
+        times.append(time.perf_counter() - t0)
+    return 1.0 / (sum(times) / len(times))
+
+
+def bench_torch_reference() -> float:
+    """Rounds/sec for the same federated round on the reference stack:
+    sequential per-client torch training (the reference's standalone path,
+    fedavg_api.py:56-66) with an equivalent ResNet-56, on CPU."""
+    import numpy as np
+    import torch
+    import torch.nn as nn
+
+    torch.manual_seed(0)
+    torch.set_num_threads(os.cpu_count() or 8)
+
+    class Block(nn.Module):
+        def __init__(self, cin, cout, stride):
+            super().__init__()
+            self.c1 = nn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.b1 = nn.BatchNorm2d(cout)
+            self.c2 = nn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.b2 = nn.BatchNorm2d(cout)
+            self.short = (
+                nn.Sequential(nn.Conv2d(cin, cout, 1, stride, bias=False), nn.BatchNorm2d(cout))
+                if (stride != 1 or cin != cout)
+                else nn.Identity()
+            )
+
+        def forward(self, x):
+            h = torch.relu(self.b1(self.c1(x)))
+            h = self.b2(self.c2(h))
+            return torch.relu(h + self.short(x))
+
+    def resnet56_torch():
+        layers = [nn.Conv2d(3, 16, 3, 1, 1, bias=False), nn.BatchNorm2d(16), nn.ReLU()]
+        cin = 16
+        for stage, cout in enumerate([16, 32, 64]):
+            for b in range(9):
+                layers.append(Block(cin, cout, 2 if (stage > 0 and b == 0) else 1))
+                cin = cout
+        return nn.Sequential(*layers), nn.Linear(64, 10)
+
+    body, head = resnet56_torch()
+    opt = torch.optim.SGD(list(body.parameters()) + list(head.parameters()), lr=0.1, momentum=0.9)
+    lossf = nn.CrossEntropyLoss()
+    x = torch.rand(BATCH, 3, 32, 32)
+    y = torch.randint(0, 10, (BATCH,))
+
+    def step():
+        opt.zero_grad()
+        h = body(x).mean(dim=(2, 3))
+        loss = lossf(head(h), y)
+        loss.backward()
+        opt.step()
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    n_meas = 3
+    for _ in range(n_meas):
+        step()
+    per_step = (time.perf_counter() - t0) / n_meas
+    # one federated round = CLIENTS sequential clients x EPOCHS x STEPS steps
+    round_time = per_step * STEPS * EPOCHS * CLIENTS
+    return 1.0 / round_time
+
+
+def main():
+    cache = {}
+    if CACHE.exists():
+        try:
+            cache = json.loads(CACHE.read_text())
+        except Exception:
+            cache = {}
+    key = f"torch_cpu_resnet56_c{CLIENTS}_s{STEPS}_b{BATCH}_e{EPOCHS}"
+    if key not in cache:
+        cache[key] = bench_torch_reference()
+        try:
+            CACHE.write_text(json.dumps(cache))
+        except OSError:
+            pass
+    baseline = cache[key]
+
+    ours = bench_jax()
+    print(json.dumps({
+        "metric": "fedavg_rounds_per_sec_resnet56_cifar10_10clients",
+        "value": round(ours, 4),
+        "unit": "rounds/sec",
+        "vs_baseline": round(ours / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

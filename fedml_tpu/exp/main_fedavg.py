@@ -1,0 +1,211 @@
+"""Unified experiment entry point.
+
+Flag names follow the reference CLI exactly (fedml_experiments/distributed/
+fedavg/main_fedavg.py:46-130 ``add_args``; the unified --algorithm switch is
+the fedall entry, fedml_experiments/distributed/fedall/main_fedavg.py) so
+reference run scripts translate 1:1:
+
+    python -m fedml_tpu.exp.main_fedavg --model resnet56 --dataset cifar10 \
+        --partition_method hetero --partition_alpha 0.5 \
+        --client_num_in_total 10 --client_num_per_round 10 \
+        --batch_size 64 --lr 0.001 --epochs 20 --comm_round 100
+
+Instead of mpirun W+1 processes (run_fedavg_distributed_pytorch.sh:21), the
+whole federation runs as one jitted program over the local device mesh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+
+import numpy as np
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    # canonical reference flag set (main_fedavg.py:46-130)
+    parser.add_argument("--model", type=str, default="lr")
+    parser.add_argument("--dataset", type=str, default="mnist")
+    parser.add_argument("--data_dir", type=str, default=None)
+    parser.add_argument("--partition_method", type=str, default="hetero")
+    parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--client_num_in_total", type=int, default=10)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--client_optimizer", type=str, default="sgd")
+    parser.add_argument("--lr", type=float, default=0.03)
+    parser.add_argument("--wd", type=float, default=0.0)
+    parser.add_argument("--momentum", type=float, default=0.0)
+    parser.add_argument("--epochs", type=int, default=1)
+    parser.add_argument("--comm_round", type=int, default=10)
+    parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--ci", type=int, default=0)
+    parser.add_argument("--is_mobile", type=int, default=0)  # parity no-op: payloads are arrays
+    parser.add_argument("--backend", type=str, default="sim",
+                        help="sim (single-program) | loopback | grpc")
+    # algorithm switch (fedall) + algorithm-specific knobs
+    parser.add_argument("--algorithm", type=str, default="fedavg",
+                        choices=["fedavg", "fedopt", "fedprox", "fednova", "fedgan",
+                                 "hierarchical", "decentralized", "fedavg_robust"])
+    parser.add_argument("--server_optimizer", type=str, default="adam")
+    parser.add_argument("--server_lr", type=float, default=1e-1)
+    parser.add_argument("--server_momentum", type=float, default=0.9)
+    parser.add_argument("--fedprox_mu", type=float, default=0.1)
+    parser.add_argument("--group_num", type=int, default=2)
+    parser.add_argument("--group_comm_round", type=int, default=2)
+    # robustness knobs (fedavg_robust main_fedavg_robust.py args)
+    parser.add_argument("--norm_bound", type=float, default=0.0)
+    parser.add_argument("--stddev", type=float, default=0.0)
+    parser.add_argument("--robust_rule", type=str, default="mean")
+    # observability
+    parser.add_argument("--run_dir", type=str, default=None)
+    parser.add_argument("--enable_wandb", type=int, default=0)
+    parser.add_argument("--checkpoint_dir", type=str, default=None)
+    parser.add_argument("--checkpoint_every", type=int, default=0)
+    parser.add_argument("--resume", type=int, default=0)
+    return parser
+
+
+def build_trainer(args, model, dataset_name: str):
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.registry import task_for_dataset
+
+    if args.client_optimizer == "sgd":
+        opt = optax.sgd(args.lr, momentum=args.momentum or None)
+    else:
+        opt = optax.adam(args.lr)
+    if args.wd:
+        opt = optax.chain(optax.add_decayed_weights(args.wd), opt)
+    prox = args.fedprox_mu if args.algorithm == "fedprox" else 0.0
+    return ClientTrainer(
+        module=model,
+        task=task_for_dataset(dataset_name),
+        optimizer=opt,
+        epochs=args.epochs,
+        prox_mu=prox,
+    )
+
+
+def build_aggregator(args, train_data):
+    from fedml_tpu.algorithms import (
+        RobustConfig,
+        fedavg_aggregator,
+        fednova_aggregator,
+        fedopt_aggregator,
+        robust_aggregator,
+        server_optimizer,
+    )
+
+    if args.algorithm == "fedopt":
+        return fedopt_aggregator(
+            server_optimizer(args.server_optimizer, args.server_lr, args.server_momentum)
+        )
+    if args.algorithm == "fednova":
+        return fednova_aggregator(
+            client_lr=args.lr, momentum=args.momentum, mu=0.0,
+            batch_size=args.batch_size, epochs=args.epochs,
+            max_client_samples=train_data.max_client_size(),
+        )
+    if args.algorithm == "fedavg_robust":
+        return robust_aggregator(RobustConfig(
+            norm_bound=args.norm_bound, stddev=args.stddev, rule=args.robust_rule,
+        ))
+    return fedavg_aggregator()
+
+
+def run(args) -> list[dict]:
+    import jax
+
+    from fedml_tpu.data import load_partition_data
+    from fedml_tpu.models import create_model
+    from fedml_tpu.obs.metrics import MetricsLogger, logging_config
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    logging_config(0)
+    logging.info("devices: %s", jax.devices())
+
+    ds = load_partition_data(
+        args.dataset, args.data_dir, args.partition_method, args.partition_alpha,
+        args.client_num_in_total, args.seed,
+    )
+    model = create_model(args.model, ds.class_num, args.dataset)
+    trainer = build_trainer(args, model, args.dataset)
+    aggregator = build_aggregator(args, ds.train)
+
+    cfg = SimConfig(
+        client_num_in_total=ds.train.num_clients,
+        client_num_per_round=min(args.client_num_per_round, ds.train.num_clients),
+        batch_size=args.batch_size,
+        comm_round=args.comm_round,
+        epochs=args.epochs,
+        frequency_of_the_test=args.frequency_of_the_test if not args.ci else args.comm_round,
+        seed=args.seed,
+    )
+
+    metrics = MetricsLogger(run_dir=args.run_dir, use_wandb=bool(args.enable_wandb))
+
+    if args.algorithm == "hierarchical":
+        from fedml_tpu.algorithms.hierarchical import HierarchicalFedAvg, HierConfig
+
+        sim = FedSim(trainer, ds.train, ds.test_arrays, cfg, aggregator=aggregator)
+        hier = HierarchicalFedAvg(sim, HierConfig(
+            group_num=args.group_num,
+            global_comm_round=args.comm_round,
+            group_comm_round=args.group_comm_round,
+        ))
+        _, history = hier.run()
+        for rec in history:
+            metrics.log(rec)
+        metrics.close()
+        return history
+
+    sim = FedSim(trainer, ds.train, ds.test_arrays, cfg, aggregator=aggregator)
+
+    ckptr = None
+    if args.checkpoint_dir:
+        from fedml_tpu.obs.checkpoint import RoundCheckpointer
+
+        ckptr = RoundCheckpointer(args.checkpoint_dir)
+
+    # checkpoint/resume-aware run loop
+    from fedml_tpu.core import rng as rnglib
+
+    variables = jax.device_put(sim.init_variables(), sim._rep)
+    server_state = sim.aggregator.init_state(variables)
+    start_round = 0
+    history: list[dict] = []
+    if args.resume and ckptr is not None and ckptr.latest_round() is not None:
+        variables, server_state, start_round, history = ckptr.restore(variables, like_server_state=server_state)
+        start_round += 1
+        logging.info("resumed from round %d", start_round - 1)
+
+    root = rnglib.root_key(cfg.seed)
+    for r in range(start_round, cfg.comm_round):
+        variables, server_state, m = sim.run_round(r, variables, server_state, root)
+        jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
+        rec = {"round": r, **{k: float(v) for k, v in m.items()}}
+        if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+            rec.update(sim.evaluate(variables))
+        history.append(rec)
+        metrics.log(rec, round_idx=r)
+        if ckptr is not None and args.checkpoint_every and (r + 1) % args.checkpoint_every == 0:
+            ckptr.save(r, variables, server_state, history)
+    metrics.close()
+    return history
+
+
+def main(argv=None):
+    parser = add_args(argparse.ArgumentParser("fedml_tpu unified entry"))
+    args = parser.parse_args(argv)
+    history = run(args)
+    final = history[-1] if history else {}
+    logging.info("final: %s", final)
+    return final
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,91 @@
+"""Metrics & logging.
+
+Reference channels (SURVEY §5.5): python logging with per-process format
+(fedml_api/utils/logger.py:7), wandb learning curves keyed Train/Acc,
+Train/Loss, Test/Acc, Test/Loss by round (FedAVGAggregator.py:137-163), MLOps
+MQTT telemetry (fedml_core/mlops_logger.py). Here: one MetricsLogger with the
+same wandb key names, writing JSONL locally and forwarding to wandb when
+available; MLOps-style system metrics come from obs.sysstats.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from pathlib import Path
+from typing import Any
+
+
+def logging_config(process_id: int = 0, level=logging.INFO) -> None:
+    """Per-process log format (fedml_api/utils/logger.py:7-32)."""
+    logging.basicConfig(
+        level=level,
+        format=f"%(asctime)s [{process_id}] %(filename)s[%(lineno)d] %(levelname)s: %(message)s",
+        force=True,
+    )
+
+class MetricsLogger:
+    """wandb-key-compatible metric sink (Train/Acc, Test/Acc, ... by round)."""
+
+    def __init__(self, run_dir: str | Path | None = None, use_wandb: bool = False,
+                 wandb_kwargs: dict | None = None):
+        self.run_dir = Path(run_dir) if run_dir else None
+        self._fh = None
+        if self.run_dir:
+            self.run_dir.mkdir(parents=True, exist_ok=True)
+            self._fh = open(self.run_dir / "metrics.jsonl", "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                self._wandb = wandb
+                wandb.init(**(wandb_kwargs or {}))
+            except Exception as e:  # wandb optional, never fatal
+                logging.warning("wandb unavailable: %s", e)
+        self.history: list[dict[str, Any]] = []
+
+    def log(self, metrics: dict[str, Any], round_idx: int | None = None) -> None:
+        rec = dict(metrics)
+        if round_idx is not None:
+            rec["round"] = round_idx
+        rec["_ts"] = time.time()
+        self.history.append(rec)
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+        if self._wandb:
+            self._wandb.log({k: v for k, v in rec.items() if not k.startswith("_")})
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+        if self._wandb:
+            self._wandb.finish()
+
+
+class RoundTimer:
+    """Comm/compute tick-tock instrumentation (reference fedml_core/
+    distributed/communication/utils.py:6-18 log_communication_tick/tock,
+    log_round_start/end) — wall-clock spans keyed by tag."""
+
+    def __init__(self):
+        self._open: dict[str, float] = {}
+        self.spans: list[tuple[str, float]] = []
+
+    def tick(self, tag: str) -> None:
+        self._open[tag] = time.perf_counter()
+
+    def tock(self, tag: str) -> float:
+        dt = time.perf_counter() - self._open.pop(tag)
+        self.spans.append((tag, dt))
+        logging.debug("--- %s cost: %.4fs", tag, dt)
+        return dt
+
+    def summary(self) -> dict[str, float]:
+        out: dict[str, float] = {}
+        for tag, dt in self.spans:
+            out[tag] = out.get(tag, 0.0) + dt
+        return out

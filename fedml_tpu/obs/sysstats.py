@@ -1,0 +1,58 @@
+"""System metrics (reference: fedml_api/distributed/fedavg_cross_silo/
+SysStats.py:13 — psutil+pynvml 13-metric sampler reported through
+MLOpsLogger.report_system_metric, fedml_core/mlops_logger.py:89).
+
+TPU equivalents: host cpu/mem from /proc (psutil when present), device HBM
+from jax's memory_stats(), plus process uptime/io.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any
+
+import jax
+
+try:
+    import psutil
+
+    HAS_PSUTIL = True
+except Exception:  # pragma: no cover
+    HAS_PSUTIL = False
+
+
+class SysStats:
+    def __init__(self):
+        self._t0 = time.time()
+
+    def sample(self) -> dict[str, Any]:
+        out: dict[str, Any] = {"uptime_s": time.time() - self._t0}
+        if HAS_PSUTIL:
+            vm = psutil.virtual_memory()
+            p = psutil.Process()
+            out.update(
+                cpu_utilization=psutil.cpu_percent(interval=None),
+                system_memory_utilization=vm.percent,
+                process_memory_in_use=p.memory_info().rss,
+                process_memory_available=vm.available,
+                process_cpu_threads_in_use=p.num_threads(),
+            )
+        else:  # /proc fallback
+            try:
+                with open("/proc/self/status") as fh:
+                    for line in fh:
+                        if line.startswith("VmRSS"):
+                            out["process_memory_in_use"] = int(line.split()[1]) * 1024
+            except OSError:
+                pass
+        # device (HBM) stats — the TPU analogue of gpu util/mem/temp/power
+        for i, dev in enumerate(jax.local_devices()):
+            try:
+                ms = dev.memory_stats()
+            except Exception:
+                ms = None
+            if ms:
+                out[f"device{i}_bytes_in_use"] = ms.get("bytes_in_use")
+                out[f"device{i}_bytes_limit"] = ms.get("bytes_limit")
+        return out

@@ -42,3 +42,14 @@ def replicated(mesh: Mesh) -> NamedSharding:
 def client_sharded(mesh: Mesh) -> NamedSharding:
     """Shard the leading (client) axis of every leaf over the clients axis."""
     return NamedSharding(mesh, P(CLIENT_AXIS))
+
+
+def cohort_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for [C, S, B, ...] cohort stacks: client axis over ``clients``;
+    on a 2-D mesh the within-client batch axis additionally shards over
+    ``silo`` — intra-silo data parallelism, the reference's in-silo DDP
+    (fedavg_cross_silo/DistWorker.py:53) as a mesh axis with XLA inserting the
+    gradient all-reduce over ICI."""
+    if SILO_AXIS in mesh.axis_names:
+        return NamedSharding(mesh, P(CLIENT_AXIS, None, SILO_AXIS))
+    return NamedSharding(mesh, P(CLIENT_AXIS))

@@ -83,7 +83,7 @@ class FedSim:
         )
 
         self._rep = meshlib.replicated(self.mesh)
-        self._shard = meshlib.client_sharded(self.mesh)
+        self._shard = meshlib.cohort_batch_sharding(self.mesh)
 
         self._round_fn = jax.jit(
             self._round_impl,

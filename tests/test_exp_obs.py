@@ -1,0 +1,94 @@
+"""Entry-point, metrics, and checkpoint/resume tests."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.exp.main_fedavg import add_args, run
+from fedml_tpu.obs.checkpoint import RoundCheckpointer
+from fedml_tpu.obs.metrics import MetricsLogger, RoundTimer
+from fedml_tpu.obs.sysstats import SysStats
+
+import argparse
+
+
+def _args(extra=None):
+    parser = add_args(argparse.ArgumentParser())
+    base = [
+        "--model", "lr", "--dataset", "synthetic_0.5_0.5",
+        "--client_num_in_total", "8", "--client_num_per_round", "4",
+        "--batch_size", "8", "--comm_round", "3", "--frequency_of_the_test", "3",
+        "--lr", "0.05",
+    ]
+    return parser.parse_args(base + (extra or []))
+
+
+def test_cli_fedavg_runs(tmp_path):
+    history = run(_args(["--run_dir", str(tmp_path)]))
+    assert len(history) == 3
+    assert "Test/Acc" in history[-1]
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 3
+    assert "Train/Loss" in json.loads(lines[0])
+
+
+def test_cli_fedopt_and_fednova_and_robust():
+    for algo_flags in (
+        ["--algorithm", "fedopt", "--server_optimizer", "adam", "--server_lr", "0.05"],
+        ["--algorithm", "fednova"],
+        ["--algorithm", "fedprox", "--fedprox_mu", "0.5"],
+        ["--algorithm", "fedavg_robust", "--norm_bound", "5.0", "--robust_rule", "median"],
+    ):
+        history = run(_args(algo_flags))
+        assert np.isfinite(history[-1]["Train/Loss"]), algo_flags
+
+
+def test_cli_hierarchical():
+    history = run(_args(["--algorithm", "hierarchical", "--comm_round", "2",
+                         "--group_num", "2", "--group_comm_round", "1"]))
+    assert len(history) == 2
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    variables = {"params": {"w": jnp.arange(6.0).reshape(2, 3)}}
+    server_state = ()
+    ck = RoundCheckpointer(tmp_path, keep=2)
+    for r in (0, 1, 2, 3):
+        ck.save(r, variables, server_state, history=[{"round": r}])
+    assert ck.latest_round() == 3
+    got, st, r, hist = ck.restore(variables)
+    np.testing.assert_allclose(np.asarray(got["params"]["w"]), np.arange(6.0).reshape(2, 3))
+    assert r == 3 and hist == [{"round": 3}]
+    # gc kept only 2
+    assert len(list(tmp_path.glob("round_*"))) == 2
+
+
+def test_resume_continues_training(tmp_path):
+    a1 = _args(["--checkpoint_dir", str(tmp_path), "--checkpoint_every", "1"])
+    h1 = run(a1)
+    a2 = _args(["--checkpoint_dir", str(tmp_path), "--resume", "1", "--comm_round", "5"])
+    h2 = run(a2)
+    assert h2[-1]["round"] == 4
+    # resumed history contains the pre-resume rounds
+    assert [r["round"] for r in h2][:3] == [0, 1, 2]
+
+
+def test_round_timer():
+    t = RoundTimer()
+    t.tick("comm")
+    t.tock("comm")
+    assert "comm" in t.summary()
+
+
+def test_sysstats_sample():
+    s = SysStats().sample()
+    assert "uptime_s" in s
+
+
+def test_metrics_logger_no_dir():
+    m = MetricsLogger()
+    m.log({"Train/Acc": 1.0}, round_idx=0)
+    assert m.history[0]["round"] == 0
+    m.close()
