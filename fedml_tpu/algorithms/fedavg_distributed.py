@@ -1,0 +1,226 @@
+"""Distributed FedAvg over the message-passing comm layer.
+
+Reference: the canonical 6-file package fedml_api/distributed/fedavg/ —
+message_define.py:6-9 (S2C_INIT_CONFIG=1, S2C_SYNC_MODEL=2, C2S_SEND_MODEL=3),
+FedAvgServerManager.py:18-82 (round loop in the receive handler),
+FedAvgClientManager.py:18-72, FedAVGAggregator.py:13-164.
+
+This is the *real-distributed* path: server and clients are separate
+processes/threads exchanging typed array messages (loopback for tests, shm
+for single-host multiprocess, grpc across hosts). The vectorized single-
+program engine (sim/engine.py) remains the fast path for simulation; this
+path exists for capability parity and true cross-silo deployments where
+clients own their data.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
+from fedml_tpu.core import rng as rnglib
+from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.sim.cohort import FederatedArrays, stack_cohort
+
+
+class MyMessage:
+    """Message types (reference message_define.py:6-9)."""
+
+    MSG_TYPE_S2C_INIT_CONFIG = 1
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+
+    MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
+    MSG_ARG_KEY_MODEL_DESC = "model_desc"
+    MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
+    MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
+
+
+class FedAvgDistAggregator:
+    """Server-side round state (FedAVGAggregator.py:13-108): collect models,
+    weighted-average when all arrived."""
+
+    def __init__(self, worker_num: int):
+        self.worker_num = worker_num
+        self.model_dict: dict[int, np.ndarray] = {}
+        self.sample_num_dict: dict[int, float] = {}
+        self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
+        self._lock = threading.Lock()  # reference hazard fixed (SURVEY §5.2)
+
+    def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
+        with self._lock:
+            self.model_dict[index] = flat_params
+            self.sample_num_dict[index] = sample_num
+            self.flag_client_model_uploaded_dict[index] = True
+            return all(self.flag_client_model_uploaded_dict.values())
+
+    def aggregate(self) -> np.ndarray:
+        with self._lock:
+            w = np.asarray([self.sample_num_dict[i] for i in range(self.worker_num)], np.float64)
+            w = w / w.sum()
+            out = np.zeros_like(self.model_dict[0], dtype=np.float64)
+            for i in range(self.worker_num):
+                out += w[i] * self.model_dict[i].astype(np.float64)
+            for i in range(self.worker_num):
+                self.flag_client_model_uploaded_dict[i] = False
+            return out.astype(np.float32)
+
+
+class FedAvgServerManager(ServerManager):
+    """Round protocol (FedAvgServerManager.py:31-82)."""
+
+    def __init__(self, comm: BaseCommunicationManager, worker_num: int, round_num: int,
+                 init_flat: np.ndarray, model_desc: str,
+                 client_num_in_total: int | None = None,
+                 on_round_done: Callable[[int, np.ndarray], None] | None = None):
+        super().__init__(comm, rank=0, size=worker_num + 1)
+        self.worker_num = worker_num
+        self.round_num = round_num
+        self.round_idx = 0
+        self.aggregator = FedAvgDistAggregator(worker_num)
+        self.global_flat = init_flat
+        self.model_desc = model_desc
+        self.client_num_in_total = client_num_in_total or worker_num
+        self.on_round_done = on_round_done
+
+    def send_init_msg(self) -> None:
+        cohort = rnglib.sample_clients(0, self.client_num_in_total, self.worker_num)
+        for w in range(self.worker_num):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, 0, w + 1)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC, self.model_desc)
+            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
+            self.send_message(msg)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_from_client
+        )
+
+    def _on_model_from_client(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        all_received = self.aggregator.add_local_trained_result(sender - 1, flat, n)
+        if not all_received:
+            return
+        self.global_flat = self.aggregator.aggregate()
+        if self.on_round_done:
+            self.on_round_done(self.round_idx, self.global_flat)
+        self.round_idx += 1
+        if self.round_idx >= self.round_num:
+            # graceful stop: notify clients then stop own loop (NOT MPI.Abort)
+            for w in range(self.worker_num):
+                stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
+                stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+                stop.add_params("finished", 1)
+                self.send_message(stop)
+            self.finish()
+            return
+        cohort = rnglib.sample_clients(self.round_idx, self.client_num_in_total, self.worker_num)
+        for w in range(self.worker_num):
+            sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
+            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
+            self.send_message(sync)
+
+
+class FedAvgClientManager(ClientManager):
+    """Client protocol (FedAvgClientManager.py:25-72): receive global model,
+    K local epochs on the assigned shard (jitted), send params + sample count."""
+
+    def __init__(self, comm: BaseCommunicationManager, rank: int, size: int,
+                 trainer: ClientTrainer, train_data: FederatedArrays,
+                 batch_size: int, template_variables: Any):
+        super().__init__(comm, rank, size)
+        self.trainer = trainer
+        self.train_data = train_data
+        self.batch_size = batch_size
+        self.template = template_variables
+        self._local_train = jax.jit(make_local_train(trainer))
+        self._round = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
+        self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_sync)
+
+    def _on_sync(self, msg: Message) -> None:
+        if msg.get("finished"):
+            self.finish()
+            return
+        flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        desc = msg.get(MyMessage.MSG_ARG_KEY_MODEL_DESC)
+        if desc is not None:
+            self._desc = desc
+        variables = unpack_pytree(flat, self._desc)
+        client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        batches, weights = stack_cohort(
+            self.train_data, np.asarray([client_idx]), self.batch_size,
+            rng=np.random.RandomState(1000 + self._round),
+        )
+        batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
+        new_vars, _ = self._local_train(
+            variables, batches, jax.random.key(self.rank * 100003 + self._round)
+        )
+        self._round += 1
+        flat_out, _ = pack_pytree(jax.tree.map(np.asarray, new_vars))
+        out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, flat_out)
+        out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weights[0]))
+        self.send_message(out)
+
+
+def run_distributed_fedavg_loopback(
+    trainer: ClientTrainer,
+    train_data: FederatedArrays,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+):
+    """End-to-end distributed FedAvg on the in-process loopback fabric —
+    the test harness the reference lacked (SURVEY §4). Returns the final
+    global variables."""
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(worker_num + 1)
+    sample = {
+        name: jnp.asarray(arr[:batch_size]) for name, arr in train_data.arrays.items()
+    }
+    sample.setdefault("mask", jnp.ones((batch_size,), jnp.float32))
+    template = trainer.init(jax.random.key(seed), sample)
+    template = jax.tree.map(np.asarray, template)
+    flat, desc = pack_pytree(template)
+
+    results: dict[str, np.ndarray] = {}
+    server = FedAvgServerManager(
+        LoopbackCommManager(fabric, 0), worker_num, round_num, flat, desc,
+        client_num_in_total=train_data.num_clients,
+        on_round_done=lambda r, f: results.__setitem__("final", f),
+    )
+    clients = [
+        FedAvgClientManager(
+            LoopbackCommManager(fabric, r), r, worker_num + 1, trainer,
+            train_data, batch_size, template,
+        )
+        for r in range(1, worker_num + 1)
+    ]
+
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.register_message_receive_handlers()
+    server.send_init_msg()
+    server.comm.handle_receive_message()  # blocks until round_num done
+    for t in threads:
+        t.join(timeout=30)
+    return unpack_pytree(results["final"], desc)

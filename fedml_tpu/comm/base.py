@@ -1,0 +1,47 @@
+"""Communication backend contract.
+
+Reference: fedml_core/distributed/communication/base_com_manager.py:7
+(``BaseCommunicationManager``: send_message / add_observer /
+handle_receive_message / stop_receive_message) and observer.py:4
+(``Observer.receive_message(msg_type, msg_params)``). Contract preserved;
+backends here are push-driven (no 0.3 s polling loop — the reference defect
+listed in SURVEY §7 'what NOT to port').
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from fedml_tpu.comm.message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: int, msg: "Message") -> None: ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    def __init__(self):
+        self._observers: list[Observer] = []
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        self._observers.remove(observer)
+
+    def notify(self, msg: "Message") -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.get_type(), msg)
+
+    @abc.abstractmethod
+    def send_message(self, msg: "Message") -> None: ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Block, dispatching incoming messages to observers, until stopped."""
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None: ...

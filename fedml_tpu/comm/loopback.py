@@ -1,0 +1,56 @@
+"""In-process loopback backend.
+
+The reference has no fake/in-process backend — its framework tests run real
+MPI on localhost (SURVEY §4: "a gap the TPU build should fix with an
+in-process loopback comm backend"). This backend gives every rank a queue in
+one process; ranks run in threads. It is the unit-test transport for the
+manager/algorithm protocol layers and the semantic model for the shm/grpc
+backends.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+
+
+class LoopbackFabric:
+    """Shared post office: rank -> queue. One instance per simulated cluster."""
+
+    def __init__(self, world_size: int):
+        self.world_size = world_size
+        self.queues: dict[int, queue.Queue] = {r: queue.Queue() for r in range(world_size)}
+
+    def post(self, msg: Message) -> None:
+        # serialize/deserialize through the real wire format so tests cover it
+        self.queues[msg.get_receiver_id()].put(msg.to_bytes())
+
+
+class LoopbackCommManager(BaseCommunicationManager):
+    _STOP = object()
+
+    def __init__(self, fabric: LoopbackFabric, rank: int):
+        super().__init__()
+        self.fabric = fabric
+        self.rank = rank
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.fabric.post(msg)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        q = self.fabric.queues[self.rank]
+        while self._running:
+            item = q.get()
+            if item is self._STOP:
+                break
+            self.notify(Message.from_bytes(item))
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.fabric.queues[self.rank].put(self._STOP)

@@ -1,0 +1,82 @@
+"""Worker-manager runtime (L1): handler registry + run loop.
+
+Reference: fedml_core/distributed/client/client_manager.py:21-102 and
+server/server_manager.py:15-83 — backend mux, ``register_message_receive_
+handler`` dict keyed by msg type (:87-88), blocking ``run()``, ``finish()``.
+The reference's MPI ``finish`` calls ``MPI.COMM_WORLD.Abort()`` (:93) —
+crash-the-world shutdown; here finish is a graceful stop (and backends own
+their cleanup).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from fedml_tpu.comm.base import BaseCommunicationManager, Observer
+from fedml_tpu.comm.message import Message
+
+
+def create_backend(backend: str, rank: int, world_size: int, **kw) -> BaseCommunicationManager:
+    """Backend mux (client_manager.py:28-50 equivalent): loopback | shm | grpc."""
+    if backend == "loopback":
+        return kw["fabric"].manager(rank) if hasattr(kw.get("fabric"), "manager") else _loopback(kw, rank)
+    if backend == "shm":
+        from fedml_tpu.comm.shm import ShmCommManager
+
+        return ShmCommManager(kw.get("job", "fedml"), rank, world_size)
+    if backend == "grpc":
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager, read_ip_config
+
+        ip_config = kw.get("ip_config") or read_ip_config(kw["ip_config_path"])
+        return GRPCCommManager(rank, ip_config)
+    raise ValueError(f"unknown backend {backend!r}")
+
+
+def _loopback(kw, rank):
+    from fedml_tpu.comm.loopback import LoopbackCommManager
+
+    return LoopbackCommManager(kw["fabric"], rank)
+
+
+class DistributedManager(Observer):
+    """Common base of ClientManager / ServerManager."""
+
+    def __init__(self, comm: BaseCommunicationManager, rank: int, size: int):
+        self.comm = comm
+        self.rank = rank
+        self.size = size
+        self._handlers: dict[int, Callable[[Message], None]] = {}
+        comm.add_observer(self)
+
+    # reference API names kept (client_manager.py:55-95)
+    def register_message_receive_handler(self, msg_type: int, handler: Callable[[Message], None]) -> None:
+        self._handlers[msg_type] = handler
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            logging.warning("rank %d: no handler for msg type %s", self.rank, msg_type)
+            return
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.comm.send_message(msg)
+
+    def register_message_receive_handlers(self) -> None:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self.comm.handle_receive_message()
+
+    def finish(self) -> None:
+        self.comm.stop_receive_message()
+
+
+class ClientManager(DistributedManager):
+    pass
+
+
+class ServerManager(DistributedManager):
+    pass

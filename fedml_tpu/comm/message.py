@@ -1,0 +1,149 @@
+"""Message envelope for the real-distributed path.
+
+Reference: fedml_core/distributed/communication/message.py:5-86 — a dict with
+type/sender/receiver plus arbitrary params, pickled whole (tensors included)
+over MPI (mpi_send_thread.py:27) or JSON'd over MQTT/gRPC. Here the envelope
+keeps the same key names (``msg_type``/``sender``/``receiver`` and the
+MSG_ARG_* constants) but the wire format is explicitly typed: a JSON header +
+a raw little-endian array segment per tensor — never pickled objects. Model
+payloads are (flat f32 vector, treedef-descriptor) pairs produced by
+``pack_pytree``.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import struct
+from typing import Any
+
+import numpy as np
+
+import jax
+
+
+class Message:
+    # key names kept for reference parity (message.py:9-24)
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+
+    def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
+        self.msg_params: dict[str, Any] = {
+            self.MSG_ARG_KEY_TYPE: int(msg_type),
+            self.MSG_ARG_KEY_SENDER: int(sender_id),
+            self.MSG_ARG_KEY_RECEIVER: int(receiver_id),
+        }
+
+    # --- reference API surface (message.py:26-73) ---
+    def get_sender_id(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_SENDER]
+
+    def get_receiver_id(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_RECEIVER]
+
+    def get_type(self) -> int:
+        return self.msg_params[self.MSG_ARG_KEY_TYPE]
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    def get_params(self) -> dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default=None) -> Any:
+        return self.msg_params.get(key, default)
+
+    # --- wire format: JSON header + raw array segments ---
+    MAGIC = b"FTM1"
+
+    def to_bytes(self) -> bytes:
+        header: dict[str, Any] = {}
+        arrays: list[np.ndarray] = []
+        for k, v in self.msg_params.items():
+            if isinstance(v, (np.ndarray, jax.Array)):
+                a = np.ascontiguousarray(np.asarray(v))
+                header[k] = {"__arr__": len(arrays), "dtype": str(a.dtype), "shape": list(a.shape)}
+                arrays.append(a)
+            else:
+                header[k] = v
+        hbytes = json.dumps(header).encode()
+        buf = io.BytesIO()
+        buf.write(self.MAGIC)
+        buf.write(struct.pack("<I", len(hbytes)))
+        buf.write(hbytes)
+        for a in arrays:
+            raw = a.tobytes()
+            buf.write(struct.pack("<Q", len(raw)))
+            buf.write(raw)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Message":
+        assert data[:4] == cls.MAGIC, "bad message magic"
+        (hlen,) = struct.unpack_from("<I", data, 4)
+        header = json.loads(data[8 : 8 + hlen].decode())
+        offset = 8 + hlen
+        # collect array descriptors in insertion order
+        descs = [(k, v) for k, v in header.items() if isinstance(v, dict) and "__arr__" in v]
+        descs.sort(key=lambda kv: kv[1]["__arr__"])
+        arrays = {}
+        for k, d in descs:
+            (alen,) = struct.unpack_from("<Q", data, offset)
+            offset += 8
+            arr = np.frombuffer(data, dtype=np.dtype(d["dtype"]), count=int(np.prod(d["shape"])) if d["shape"] else 1, offset=offset)
+            arrays[k] = arr.reshape(d["shape"])
+            offset += alen
+        msg = cls()
+        for k, v in header.items():
+            msg.msg_params[k] = arrays[k] if k in arrays else v
+        return msg
+
+    def __repr__(self):
+        sizes = {
+            k: f"array{tuple(v.shape)}" if isinstance(v, (np.ndarray, jax.Array)) else v
+            for k, v in self.msg_params.items()
+        }
+        return f"Message({sizes})"
+
+
+# --- pytree <-> wire payload -------------------------------------------------
+
+
+def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
+    """Flatten a pytree of arrays to (flat f32 vector, json descriptor).
+    The descriptor records leaf paths/shapes/dtypes so the receiver rebuilds
+    the exact structure — the anti-pickle wire contract (SURVEY §5.8)."""
+    from fedml_tpu.core.tree import tree_leaves_with_paths
+
+    leaves = tree_leaves_with_paths(tree)
+    desc = [
+        {"path": k, "shape": list(np.shape(v)), "dtype": str(np.asarray(v).dtype)}
+        for k, v in leaves
+    ]
+    if leaves:
+        flat = np.concatenate([np.asarray(v, dtype=np.float32).ravel() for _, v in leaves])
+    else:
+        flat = np.zeros((0,), np.float32)
+    return flat, json.dumps(desc)
+
+
+def unpack_pytree(flat: np.ndarray, descriptor: str) -> Any:
+    """Rebuild a nested dict from pack_pytree output (paths use '/')."""
+    desc = json.loads(descriptor)
+    out: dict[str, Any] = {}
+    i = 0
+    for d in desc:
+        n = int(np.prod(d["shape"])) if d["shape"] else 1
+        leaf = np.asarray(flat[i : i + n], dtype=np.float32).reshape(d["shape"]).astype(d["dtype"])
+        i += n
+        node = out
+        parts = d["path"].split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return out
