@@ -64,15 +64,18 @@ class FedAvgDistAggregator:
             return all(self.flag_client_model_uploaded_dict.values())
 
     def aggregate(self) -> np.ndarray:
+        # Payloads are pack_pytree byte vectors; model leaves are float32
+        # (validated against the descriptor at server init), so the weighted
+        # average runs on an f32 view and returns bytes for the wire.
         with self._lock:
             w = np.asarray([self.sample_num_dict[i] for i in range(self.worker_num)], np.float64)
             w = w / w.sum()
-            out = np.zeros_like(self.model_dict[0], dtype=np.float64)
+            out = np.zeros(self.model_dict[0].size // 4, dtype=np.float64)
             for i in range(self.worker_num):
-                out += w[i] * self.model_dict[i].astype(np.float64)
+                out += w[i] * np.ascontiguousarray(self.model_dict[i]).view(np.float32)
             for i in range(self.worker_num):
                 self.flag_client_model_uploaded_dict[i] = False
-            return out.astype(np.float32)
+            return out.astype(np.float32).view(np.uint8)
 
 
 class FedAvgServerManager(ServerManager):
@@ -89,6 +92,13 @@ class FedAvgServerManager(ServerManager):
         self.aggregator = FedAvgDistAggregator(worker_num)
         self.global_flat = init_flat
         self.model_desc = model_desc
+        import json
+
+        non_f32 = [d["path"] for d in json.loads(model_desc) if d["dtype"] != "float32"]
+        if non_f32:
+            raise ValueError(
+                f"flat-vector aggregation requires float32 model leaves; got {non_f32}"
+            )
         self.client_num_in_total = client_num_in_total or worker_num
         self.on_round_done = on_round_done
 

@@ -20,7 +20,7 @@ from fedml_tpu.comm.message import Message
 def create_backend(backend: str, rank: int, world_size: int, **kw) -> BaseCommunicationManager:
     """Backend mux (client_manager.py:28-50 equivalent): loopback | shm | grpc."""
     if backend == "loopback":
-        return kw["fabric"].manager(rank) if hasattr(kw.get("fabric"), "manager") else _loopback(kw, rank)
+        return _loopback(kw, rank)
     if backend == "shm":
         from fedml_tpu.comm.shm import ShmCommManager
 

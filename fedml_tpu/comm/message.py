@@ -115,9 +115,11 @@ class Message:
 
 
 def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
-    """Flatten a pytree of arrays to (flat f32 vector, json descriptor).
+    """Flatten a pytree of arrays to (flat byte vector, json descriptor).
     The descriptor records leaf paths/shapes/dtypes so the receiver rebuilds
-    the exact structure — the anti-pickle wire contract (SURVEY §5.8)."""
+    the exact structure — the anti-pickle wire contract (SURVEY §5.8).
+    Leaves keep their native dtypes byte-for-byte (int64 counters and f64
+    leaves survive the wire unchanged)."""
     from fedml_tpu.core.tree import tree_leaves_with_paths
 
     leaves = tree_leaves_with_paths(tree)
@@ -126,21 +128,27 @@ def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
         for k, v in leaves
     ]
     if leaves:
-        flat = np.concatenate([np.asarray(v, dtype=np.float32).ravel() for _, v in leaves])
+        flat = np.concatenate(
+            [np.frombuffer(np.ascontiguousarray(np.asarray(v)).tobytes(), np.uint8)
+             for _, v in leaves]
+        )
     else:
-        flat = np.zeros((0,), np.float32)
+        flat = np.zeros((0,), np.uint8)
     return flat, json.dumps(desc)
 
 
 def unpack_pytree(flat: np.ndarray, descriptor: str) -> Any:
     """Rebuild a nested dict from pack_pytree output (paths use '/')."""
     desc = json.loads(descriptor)
+    flat = np.asarray(flat, dtype=np.uint8)
     out: dict[str, Any] = {}
     i = 0
     for d in desc:
+        dt = np.dtype(d["dtype"])
         n = int(np.prod(d["shape"])) if d["shape"] else 1
-        leaf = np.asarray(flat[i : i + n], dtype=np.float32).reshape(d["shape"]).astype(d["dtype"])
-        i += n
+        nbytes = n * dt.itemsize
+        leaf = np.frombuffer(flat[i : i + nbytes].tobytes(), dtype=dt).reshape(d["shape"])
+        i += nbytes
         node = out
         parts = d["path"].split("/")
         for p in parts[:-1]:

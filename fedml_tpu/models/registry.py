@@ -12,6 +12,7 @@ from fedml_tpu.models.linear import LogisticRegression
 from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
 from fedml_tpu.models.resnet import ResNet18, resnet18_gn, resnet56, resnet110
 from fedml_tpu.models.rnn import RNNOriginalFedAvg, RNNStackOverflow
+from fedml_tpu.models.transformer import TransformerLM
 from fedml_tpu.models.vgg import VGG
 
 
@@ -41,6 +42,10 @@ def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
         return MobileNet(num_classes=output_dim)
     if model_name == "mobilenet_v3":
         return MobileNetV3(num_classes=output_dim, mode="large")
+    if model_name == "transformer":
+        # long-context LM client (no reference equivalent — extends the zoo
+        # past nlp/rnn.py; attn_impl flash/ring for single-/multi-chip)
+        return TransformerLM(vocab_size=output_dim)
     if model_name.startswith("vgg"):
         depth = int(model_name[3:] or 16)
         return VGG(depth=depth, num_classes=output_dim)

@@ -1,0 +1,121 @@
+"""Decoder-only transformer LM for long-context federated clients.
+
+The reference's NLP zoo stops at LSTMs (fedml_api/model/nlp/rnn.py:4,39); this
+model extends the zoo to transformer clients with three attention paths:
+
+- ``attn_impl="xla"``  — plain dot-product attention (small sequences; XLA
+  fuses it fine).
+- ``attn_impl="flash"`` — the pallas blockwise kernel
+  (fedml_tpu/ops/attention.py): O(T) memory on one chip.
+- ``attn_impl="ring"``  — ring attention over the ``sp`` mesh axis
+  (fedml_tpu/parallel/ring_attention.py); the module must then run inside
+  ``shard_map`` with the sequence axis sharded (see
+  fedml_tpu/parallel/sequence.py). Every other op in this module is
+  token-local, so the module is sequence-parallel-safe by construction.
+
+Same interface as the rest of the zoo: int tokens ``[B, T]`` in, logits
+``[B, T, V]`` out, ``train`` kwarg, dropout rng when training.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.ops.attention import flash_attention
+from fedml_tpu.parallel.ring_attention import ring_attention
+
+
+class MultiHeadSelfAttention(nn.Module):
+    num_heads: int
+    attn_impl: str = "xla"  # xla | flash | ring
+    sp_axis: str = "sp"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        b, t, c = x.shape
+        head_dim = c // self.num_heads
+        qkv = nn.Dense(3 * c, use_bias=False, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(a):  # [B, T, C] -> [B, H, T, D]
+            return a.reshape(b, t, self.num_heads, head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.attn_impl == "flash":
+            o = flash_attention(q, k, v, causal=True)
+        elif self.attn_impl == "ring":
+            o = ring_attention(q, k, v, axis_name=self.sp_axis, causal=True)
+        else:
+            scale = head_dim**-0.5
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+            mask = jnp.tril(jnp.ones((t, t), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            p = nn.softmax(s, axis=-1)
+            o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+        o = o.transpose(0, 2, 1, 3).reshape(b, t, c)
+        o = nn.Dense(c, use_bias=False, name="proj")(o)
+        if self.dropout_rate:
+            o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
+        return o
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attn_impl: str = "xla"
+    sp_axis: str = "sp"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.LayerNorm()(x)
+        x = x + MultiHeadSelfAttention(
+            self.num_heads, self.attn_impl, self.sp_axis, self.dropout_rate
+        )(h, train=train)
+        h = nn.LayerNorm()(x)
+        c = x.shape[-1]
+        m = nn.Dense(self.mlp_ratio * c)(h)
+        m = nn.gelu(m)
+        m = nn.Dense(c)(m)
+        if self.dropout_rate:
+            m = nn.Dropout(self.dropout_rate, deterministic=not train)(m)
+        return x + m
+
+
+class TransformerLM(nn.Module):
+    """Causal LM. Position embedding is computed from the *global* token
+    position: under sequence parallelism each shard adds ``pos_offset`` (set
+    by the SP train step) so token-locality is preserved."""
+
+    vocab_size: int = 90
+    embed_dim: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    max_len: int = 4096
+    attn_impl: str = "xla"
+    sp_axis: str = "sp"
+    dropout_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, pos_offset: int | jnp.ndarray = 0):
+        b, t = x.shape
+        tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(x)
+        pos_table = self.param(
+            "pos_embed",
+            nn.initializers.normal(0.02),
+            (self.max_len, self.embed_dim),
+        )
+        pos_idx = pos_offset + jnp.arange(t)
+        h = tok + jnp.take(pos_table, pos_idx, axis=0)[None]
+        for i in range(self.num_layers):
+            h = Block(
+                self.num_heads,
+                attn_impl=self.attn_impl,
+                sp_axis=self.sp_axis,
+                dropout_rate=self.dropout_rate,
+                name=f"block_{i}",
+            )(h, train=train)
+        h = nn.LayerNorm(name="ln_f")(h)
+        return nn.Dense(self.vocab_size, name="head")(h)

@@ -81,18 +81,40 @@ void ring_read(Ring* r, uint8_t* dst, uint64_t len) {
 extern "C" {
 
 void* shmring_create(const char* name, uint64_t capacity) {
+  // Concurrent create must be idempotent (sender lazily creates the
+  // receiver's ring while the receiver creates it at startup): elect exactly
+  // one initializer with O_EXCL; everyone else waits for magic.
   size_t total = sizeof(Header) + capacity;
-  int fd = shm_open(name, O_CREAT | O_RDWR, 0600);
-  if (fd < 0) return nullptr;
-  if (ftruncate(fd, (off_t)total) != 0) {
+  int fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  bool creator = fd >= 0;
+  if (!creator) {
+    if (errno != EEXIST) return nullptr;
+    fd = shm_open(name, O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    // wait for the creator to size the segment (ftruncate not yet done)
+    struct stat st;
+    for (int i = 0; i < 10000; ++i) {
+      if (fstat(fd, &st) != 0) {
+        close(fd);
+        return nullptr;
+      }
+      if ((size_t)st.st_size >= total) break;
+      usleep(1000);
+    }
+    if ((size_t)st.st_size < total) {
+      close(fd);
+      return nullptr;
+    }
+  } else if (ftruncate(fd, (off_t)total) != 0) {
     close(fd);
+    shm_unlink(name);
     return nullptr;
   }
   void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
   close(fd);
   if (mem == MAP_FAILED) return nullptr;
   Header* h = (Header*)mem;
-  if (h->magic != kMagic) {
+  if (creator) {
     h->capacity = capacity;
     h->head = h->tail = h->used = 0;
     pthread_mutexattr_t ma;
@@ -106,6 +128,13 @@ void* shmring_create(const char* name, uint64_t capacity) {
     pthread_cond_init(&h->can_write, &ca);
     __sync_synchronize();
     h->magic = kMagic;
+  } else {
+    for (int i = 0; i < 10000 && __sync_fetch_and_add(&h->magic, 0) != kMagic; ++i)
+      usleep(1000);
+    if (__sync_fetch_and_add(&h->magic, 0) != kMagic) {
+      munmap(mem, total);
+      return nullptr;
+    }
   }
   Ring* r = new Ring{h, (uint8_t*)mem + sizeof(Header), total};
   return r;

@@ -1,0 +1,84 @@
+"""Ring attention: exact attention over a sequence-parallel mesh axis.
+
+Long-context is first-class here even though the reference has none (SURVEY
+§5.7: max workload is 20-token StackOverflow NWP). Sequences are sharded over
+the ``sp`` mesh axis; each device holds its local Q/K/V chunk ``[B, H, T/P, D]``
+and K/V chunks rotate around the ring via ``lax.ppermute`` (XLA lowers this to
+ICI neighbor exchange) while every device accumulates its queries' attention
+with the same online-softmax update the pallas kernel uses
+(fedml_tpu/ops/attention.py). After P steps every query has seen every key —
+exact attention, O(T/P) memory per chip, compute/communication overlapped by
+XLA's async collectives.
+
+Usable only inside ``shard_map`` (it calls collectives on ``axis_name``). The
+TransformerLM picks it via ``attn_impl="ring"`` and
+fedml_tpu/parallel/sequence.py builds the surrounding sharded train step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    sm_scale: float | None = None,
+) -> jnp.ndarray:
+    """Exact attention with K/V rotating around the ``axis_name`` ring.
+
+    q, k, v: local chunks ``[B, H, T_local, D]`` of a sequence sharded over
+    ``axis_name``. Returns the local output chunk ``[B, H, T_local, D]``.
+    """
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, h, t_loc, d = q.shape
+
+    qf = q.astype(jnp.float32) * sm_scale
+    q_pos = my_idx * t_loc + jax.lax.broadcasted_iota(jnp.int32, (t_loc, t_loc), 0)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        o, l, m, k_cur, v_cur = carry
+        # after i rotations this device holds the block originally on my_idx - i
+        blk = (my_idx - i) % axis_size
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_cur.astype(jnp.float32))
+        if causal:
+            k_pos = blk * t_loc + jax.lax.broadcasted_iota(
+                jnp.int32, (t_loc, t_loc), 1
+            )
+            s = jnp.where((k_pos <= q_pos)[None, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(s <= NEG_INF / 2, 0.0, p)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32))
+        # the last iteration's rotation would be discarded — skip the ICI hop
+        k_nxt, v_nxt = jax.lax.cond(
+            i < axis_size - 1,
+            lambda kv: (
+                jax.lax.ppermute(kv[0], axis_name, perm),
+                jax.lax.ppermute(kv[1], axis_name, perm),
+            ),
+            lambda kv: kv,
+            (k_cur, v_cur),
+        )
+        return (o, l, m_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros((b, h, t_loc, d), jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc, 1), jnp.float32)
+    m0 = jnp.full((b, h, t_loc, 1), NEG_INF, jnp.float32)
+    (o, l, _, _, _), _ = jax.lax.scan(
+        step, (o0, l0, m0, k, v), jnp.arange(axis_size)
+    )
+    return (o / jnp.maximum(l, 1e-20)).astype(q.dtype)

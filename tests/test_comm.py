@@ -41,10 +41,24 @@ def test_pack_unpack_pytree():
                                    "bias": np.zeros(3, np.float32)}},
             "batch_stats": {"mean": np.full(3, 0.5, np.float32)}}
     flat, desc = pack_pytree(tree)
-    assert flat.shape == (12,)
+    assert flat.dtype == np.uint8 and flat.shape == (48,)  # 12 f32 leaves as bytes
     back = unpack_pytree(flat, desc)
     np.testing.assert_array_equal(back["params"]["Dense_0"]["kernel"], tree["params"]["Dense_0"]["kernel"])
     np.testing.assert_array_equal(back["batch_stats"]["mean"], tree["batch_stats"]["mean"])
+
+
+def test_pack_unpack_preserves_dtypes():
+    """int64 counters and f64 leaves must survive the wire bit-exactly."""
+    tree = {
+        "count": np.array(16_777_217, np.int64),  # not representable in f32
+        "table": np.arange(6, dtype=np.int32).reshape(2, 3),
+        "wide": np.array([1.0 + 1e-12], np.float64),
+        "w": np.ones(3, np.float32),
+    }
+    back = unpack_pytree(*pack_pytree(tree))
+    for k in tree:
+        assert back[k].dtype == tree[k].dtype, k
+        np.testing.assert_array_equal(back[k], tree[k])
 
 
 def test_loopback_fabric():
