@@ -1,0 +1,142 @@
+"""Federated semantic segmentation (fedseg).
+
+Reference: fedml_api/distributed/fedseg/ — per-client mIoU / FWIoU /
+pixel-accuracy evaluation via a confusion-matrix ``Evaluator``
+(fedseg/utils.py, MyModelTrainer.py:92-125), an aggregator that tracks
+per-client eval dicts plus global averages (FedSegAggregator.py:105-235), and
+an ``EvaluationMetricsKeeper`` record per client.
+
+TPU design: training is ordinary FedAvg over a segmentation ClientTrainer
+(task="segmentation" — per-pixel CE inside the same vmapped scan). The
+evaluator becomes pure array math: each client's confusion matrix accumulates
+inside the jitted eval (one [C, C] scatter-add per batch), the cohort's
+matrices come back stacked ``[num_clients, C, C]``, and every reference metric
+is a closed-form reduction of that stack — the reference's serial per-client
+Python eval loop is one vmapped program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.trainer import ClientTrainer, make_local_eval
+from fedml_tpu.sim import cohort as cohortlib
+from fedml_tpu.sim.engine import FedSim
+
+
+# ---------------------------------------------------------------------------
+# Metrics from confusion matrices (reference fedseg/utils.py Evaluator)
+# ---------------------------------------------------------------------------
+
+
+def pixel_accuracy(conf: jnp.ndarray) -> jnp.ndarray:
+    return jnp.trace(conf) / jnp.maximum(jnp.sum(conf), 1.0)
+
+
+def pixel_accuracy_class(conf: jnp.ndarray) -> jnp.ndarray:
+    per_class = jnp.diag(conf) / jnp.maximum(jnp.sum(conf, axis=1), 1.0)
+    present = jnp.sum(conf, axis=1) > 0
+    return jnp.sum(jnp.where(present, per_class, 0.0)) / jnp.maximum(
+        jnp.sum(present), 1.0
+    )
+
+
+def iou_per_class(conf: jnp.ndarray) -> jnp.ndarray:
+    inter = jnp.diag(conf)
+    union = jnp.sum(conf, axis=0) + jnp.sum(conf, axis=1) - inter
+    return inter / jnp.maximum(union, 1.0)
+
+
+def mean_iou(conf: jnp.ndarray) -> jnp.ndarray:
+    union = jnp.sum(conf, axis=0) + jnp.sum(conf, axis=1) - jnp.diag(conf)
+    present = union > 0
+    iou = iou_per_class(conf)
+    return jnp.sum(jnp.where(present, iou, 0.0)) / jnp.maximum(jnp.sum(present), 1.0)
+
+
+def frequency_weighted_iou(conf: jnp.ndarray) -> jnp.ndarray:
+    freq = jnp.sum(conf, axis=1) / jnp.maximum(jnp.sum(conf), 1.0)
+    iou = iou_per_class(conf)
+    return jnp.sum(jnp.where(freq > 0, freq * iou, 0.0))
+
+
+@dataclasses.dataclass
+class EvaluationMetricsKeeper:
+    """Per-client eval record (reference fedseg/utils.py
+    EvaluationMetricsKeeper — acc / acc_class / mIoU / FWIoU / loss)."""
+
+    accuracy: float
+    accuracy_class: float
+    mIoU: float
+    FWIoU: float
+    loss: float
+
+
+def metrics_from_confusion(conf: np.ndarray, loss: float = 0.0) -> EvaluationMetricsKeeper:
+    c = jnp.asarray(conf)
+    return EvaluationMetricsKeeper(
+        accuracy=float(pixel_accuracy(c)),
+        accuracy_class=float(pixel_accuracy_class(c)),
+        mIoU=float(mean_iou(c)),
+        FWIoU=float(frequency_weighted_iou(c)),
+        loss=float(loss),
+    )
+
+
+# ---------------------------------------------------------------------------
+# FedSeg simulation: FedAvg + vectorized per-client segmentation eval
+# ---------------------------------------------------------------------------
+
+
+class FedSegSim(FedSim):
+    """FedAvg on a segmentation trainer + the fedseg evaluation protocol.
+
+    ``evaluate_clients`` replaces the reference aggregator's per-client eval
+    dict bookkeeping (FedSegAggregator.py:105-235): one jitted vmap returns
+    every client's confusion matrix; global metrics come from the summed
+    matrix (exactly the reference's global average over clients, but weighted
+    by true pixel counts rather than a mean of per-client ratios).
+    """
+
+    def __init__(self, trainer: ClientTrainer, train_data, test_arrays, config,
+                 aggregator=None, mesh=None):
+        assert trainer.task == "segmentation", "FedSegSim requires the segmentation task"
+        super().__init__(trainer, train_data, test_arrays, config,
+                         aggregator=aggregator, mesh=mesh)
+        self._client_eval = jax.jit(
+            jax.vmap(make_local_eval(self.trainer), in_axes=(None, 0))
+        )
+
+    def evaluate_clients(self, variables, client_ids=None, batch_size=None):
+        """Returns (per-client EvaluationMetricsKeeper dict, global metrics dict)."""
+        cfg = self.config
+        ids = np.asarray(
+            client_ids
+            if client_ids is not None
+            else np.arange(cfg.client_num_in_total)
+        )
+        stack = cohortlib.stack_client_eval(
+            self.train_data, ids, batch_size or cfg.eval_batch_size
+        )
+        m = self._client_eval(variables, jax.tree.map(jnp.asarray, stack))
+        confs = np.asarray(m["confusion"])  # [C_clients, num_classes, num_classes]
+        losses = np.asarray(m["test_loss"]) / np.maximum(np.asarray(m["test_total"]), 1.0)
+        per_client = {
+            int(cid): metrics_from_confusion(confs[i], losses[i])
+            for i, cid in enumerate(ids)
+        }
+        global_conf = confs.sum(axis=0)
+        total = float(np.maximum(np.asarray(m["test_total"]).sum(), 1.0))
+        global_metrics = {
+            "Eval/PixelAcc": float(pixel_accuracy(jnp.asarray(global_conf))),
+            "Eval/AccClass": float(pixel_accuracy_class(jnp.asarray(global_conf))),
+            "Eval/mIoU": float(mean_iou(jnp.asarray(global_conf))),
+            "Eval/FWIoU": float(frequency_weighted_iou(jnp.asarray(global_conf))),
+            "Eval/Loss": float(np.asarray(m["test_loss"]).sum() / total),
+        }
+        return per_client, global_metrics

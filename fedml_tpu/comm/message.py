@@ -6,8 +6,9 @@ over MPI (mpi_send_thread.py:27) or JSON'd over MQTT/gRPC. Here the envelope
 keeps the same key names (``msg_type``/``sender``/``receiver`` and the
 MSG_ARG_* constants) but the wire format is explicitly typed: a JSON header +
 a raw little-endian array segment per tensor — never pickled objects. Model
-payloads are (flat f32 vector, treedef-descriptor) pairs produced by
-``pack_pytree``.
+payloads are (flat byte vector, leaf-descriptor) pairs produced by
+``pack_pytree`` — leaves keep their native dtypes bit-exactly; the descriptor
+records path/shape/dtype per leaf.
 """
 
 from __future__ import annotations

@@ -99,11 +99,47 @@ def tag_metrics(logits: jnp.ndarray, batch: Batch) -> dict[str, jnp.ndarray]:
     }
 
 
+def _pixel_mask(batch: Batch, ce: jnp.ndarray) -> jnp.ndarray:
+    """Broadcast an example-level [B] (or pixel-level [B, H, W]) mask to the
+    per-pixel CE shape."""
+    m = batch["mask"]
+    while m.ndim < ce.ndim:
+        m = m[..., None]
+    return jnp.broadcast_to(m, ce.shape)
+
+
+def segmentation_loss(logits: jnp.ndarray, batch: Batch) -> jnp.ndarray:
+    """Per-pixel CE for [B, H, W, C] logits vs [B, H, W] int labels
+    (reference fedml_api/distributed/fedseg/utils.py SegmentationLosses.CELoss)."""
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+    m = _pixel_mask(batch, ce)
+    return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+def segmentation_metrics(logits: jnp.ndarray, batch: Batch) -> dict[str, jnp.ndarray]:
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
+    pred = jnp.argmax(logits, -1)
+    m = _pixel_mask(batch, ce)
+    correct = (pred == batch["y"]).astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    # confusion matrix [C, C] (true, pred) — the fedseg Evaluator's core
+    # (reference fedseg/utils.py Evaluator.add_batch confusion accumulation)
+    idx = batch["y"] * num_classes + pred
+    conf = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx.ravel()].add(m.ravel())
+    return {
+        "test_correct": jnp.sum(correct * m),
+        "test_loss": jnp.sum(ce * m),  # per-pixel sum; engine divides by total
+        "test_total": jnp.sum(m),
+        "confusion": conf.reshape(num_classes, num_classes),
+    }
+
+
 TASKS: dict[str, tuple[Callable, Callable]] = {
     "classification": (classification_loss, classification_metrics),
     "nwp": (lm_loss, lm_metrics),
     "char_lm": (lm_loss, lm_metrics),
     "tag": (tag_loss, tag_metrics),
+    "segmentation": (segmentation_loss, segmentation_metrics),
 }
 
 

@@ -42,6 +42,14 @@ def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
         return MobileNet(num_classes=output_dim)
     if model_name == "mobilenet_v3":
         return MobileNetV3(num_classes=output_dim, mode="large")
+    if model_name == "unet":
+        from fedml_tpu.models.segmentation import UNet
+
+        return UNet(num_classes=output_dim)
+    if model_name in ("deeplab", "deeplab_lite"):
+        from fedml_tpu.models.segmentation import DeepLabLite
+
+        return DeepLabLite(num_classes=output_dim)
     if model_name == "transformer":
         # long-context LM client (no reference equivalent — extends the zoo
         # past nlp/rnn.py; attn_impl flash/ring for single-/multi-chip)

@@ -1,0 +1,136 @@
+"""Semantic-segmentation models for federated segmentation (fedseg).
+
+The reference's fedseg package trains torchvision-style DeepLab/UNet encoders
+held outside the repo (SURVEY §2.2 fedseg row: "torchvision-style seg models
+(external)") — the in-repo capability is the federated wrapper + evaluator.
+Here the zoo carries its own compact TPU-friendly models so fedseg runs end
+to end:
+
+- ``UNet`` — classic encoder/decoder with skip connections.
+- ``DeepLabLite`` — dilated-conv encoder + ASPP head (DeepLabV3 shape).
+
+Both use GroupNorm (cross-client BN statistics are the reference's known
+pain point, SURVEY §7 "BatchNorm across clients") and NHWC layouts; every
+conv maps onto the MXU as an implicit matmul. Inputs ``[B, H, W, C]``,
+logits ``[B, H, W, num_classes]``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _gn(groups: int, c: int) -> int:
+    g = min(groups, c)
+    while c % g:
+        g -= 1
+    return g
+
+
+def _interp_matrix(src: int, dst: int, method: str) -> jnp.ndarray:
+    """[dst, src] 1-D interpolation matrix (half-pixel centers).
+
+    Upsampling as two einsum contractions instead of ``jax.image.resize``:
+    resize's transpose lowers to a feature-grouped conv that XLA's SPMD
+    partitioner rejects when the batch axis is sharded (the vmapped-cohort
+    case); a matmul transposes to a matmul and rides the MXU."""
+    import numpy as np
+
+    if method == "nearest":
+        src_idx = np.clip(((np.arange(dst) + 0.5) * src / dst).astype(int), 0, src - 1)
+        m = np.zeros((dst, src), np.float32)
+        m[np.arange(dst), src_idx] = 1.0
+        return jnp.asarray(m)
+    # bilinear
+    coords = (np.arange(dst) + 0.5) * src / dst - 0.5
+    lo = np.clip(np.floor(coords).astype(int), 0, src - 1)
+    hi = np.clip(lo + 1, 0, src - 1)
+    frac = np.clip(coords - lo, 0.0, 1.0)
+    m = np.zeros((dst, src), np.float32)
+    np.add.at(m, (np.arange(dst), lo), 1.0 - frac)
+    np.add.at(m, (np.arange(dst), hi), frac)
+    return jnp.asarray(m)
+
+
+def upsample_2d(x: jnp.ndarray, out_hw: tuple[int, int], method: str = "nearest") -> jnp.ndarray:
+    """[B, H, W, C] -> [B, out_h, out_w, C] via separable interpolation einsums."""
+    mh = _interp_matrix(x.shape[1], out_hw[0], method)
+    mw = _interp_matrix(x.shape[2], out_hw[1], method)
+    return jnp.einsum("hH,bHWc,wW->bhwc", mh, x, mw)
+
+
+class ConvBlock(nn.Module):
+    features: int
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(2):
+            x = nn.Conv(self.features, (3, 3), kernel_dilation=self.dilation,
+                        padding="SAME", use_bias=False)(x)
+            x = nn.GroupNorm(num_groups=_gn(8, self.features))(x)
+            x = nn.relu(x)
+        return x
+
+
+class UNet(nn.Module):
+    num_classes: int = 21
+    features: Sequence[int] = (32, 64, 128)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        skips = []
+        for f in self.features[:-1]:
+            x = ConvBlock(f)(x)
+            skips.append(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[-1])(x)
+        for f, skip in zip(reversed(self.features[:-1]), reversed(skips)):
+            b, h, w, _ = skip.shape
+            x = upsample_2d(x, (h, w), "nearest")
+            x = nn.Conv(f, (2, 2), padding="SAME")(x)
+            x = jnp.concatenate([x, skip], axis=-1)
+            x = ConvBlock(f)(x)
+        return nn.Conv(self.num_classes, (1, 1))(x)
+
+
+class ASPP(nn.Module):
+    """Atrous spatial pyramid pooling (DeepLabV3 head)."""
+
+    features: int = 128
+    rates: Sequence[int] = (1, 2, 4)
+
+    @nn.compact
+    def __call__(self, x):
+        branches = [
+            ConvBlock(self.features, dilation=r)(x) for r in self.rates
+        ]
+        # image-level pooling branch
+        pooled = jnp.mean(x, axis=(1, 2), keepdims=True)
+        pooled = nn.Conv(self.features, (1, 1))(pooled)
+        pooled = jnp.broadcast_to(
+            pooled, (x.shape[0], x.shape[1], x.shape[2], self.features)
+        )
+        x = jnp.concatenate(branches + [pooled], axis=-1)
+        return nn.Conv(self.features, (1, 1))(x)
+
+
+class DeepLabLite(nn.Module):
+    num_classes: int = 21
+    features: Sequence[int] = (32, 64, 128)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_h, in_w = x.shape[1], x.shape[2]
+        x = ConvBlock(self.features[0])(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[1])(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = ConvBlock(self.features[2], dilation=2)(x)  # dilated, no more stride
+        x = ASPP(self.features[2])(x)
+        logits = nn.Conv(self.num_classes, (1, 1))(x)
+        return upsample_2d(logits, (in_h, in_w), "bilinear")

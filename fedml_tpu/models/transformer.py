@@ -22,7 +22,7 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedml_tpu.ops.attention import flash_attention
+from fedml_tpu.ops.attention import attention_reference, flash_attention
 from fedml_tpu.parallel.ring_attention import ring_attention
 
 
@@ -48,12 +48,7 @@ class MultiHeadSelfAttention(nn.Module):
         elif self.attn_impl == "ring":
             o = ring_attention(q, k, v, axis_name=self.sp_axis, causal=True)
         else:
-            scale = head_dim**-0.5
-            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-            mask = jnp.tril(jnp.ones((t, t), bool))
-            s = jnp.where(mask[None, None], s, -1e30)
-            p = nn.softmax(s, axis=-1)
-            o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+            o = attention_reference(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, c)
         o = nn.Dense(c, use_bias=False, name="proj")(o)
         if self.dropout_rate:

@@ -80,7 +80,20 @@ void ring_read(Ring* r, uint8_t* dst, uint64_t len) {
 
 extern "C" {
 
+void* shmring_try_create(const char* name, uint64_t capacity);
+
 void* shmring_create(const char* name, uint64_t capacity) {
+  void* r = shmring_try_create(name, capacity);
+  if (r) return r;
+  // Attach timed out: a creator died between O_EXCL and magic publication,
+  // leaving a stale half-initialized segment. Unlink it and retry once —
+  // this restores the old check-magic-and-reinit self-healing without its
+  // concurrent-init race.
+  shm_unlink(name);
+  return shmring_try_create(name, capacity);
+}
+
+void* shmring_try_create(const char* name, uint64_t capacity) {
   // Concurrent create must be idempotent (sender lazily creates the
   // receiver's ring while the receiver creates it at startup): elect exactly
   // one initializer with O_EXCL; everyone else waits for magic.
@@ -93,7 +106,7 @@ void* shmring_create(const char* name, uint64_t capacity) {
     if (fd < 0) return nullptr;
     // wait for the creator to size the segment (ftruncate not yet done)
     struct stat st;
-    for (int i = 0; i < 10000; ++i) {
+    for (int i = 0; i < 2000; ++i) {
       if (fstat(fd, &st) != 0) {
         close(fd);
         return nullptr;
@@ -129,7 +142,7 @@ void* shmring_create(const char* name, uint64_t capacity) {
     __sync_synchronize();
     h->magic = kMagic;
   } else {
-    for (int i = 0; i < 10000 && __sync_fetch_and_add(&h->magic, 0) != kMagic; ++i)
+    for (int i = 0; i < 2000 && __sync_fetch_and_add(&h->magic, 0) != kMagic; ++i)
       usleep(1000);
     if (__sync_fetch_and_add(&h->magic, 0) != kMagic) {
       munmap(mem, total);

@@ -84,9 +84,27 @@ class FedSim:
 
         self._rep = meshlib.replicated(self.mesh)
         self._shard = meshlib.cohort_batch_sharding(self.mesh)
+        self._n_client_shards = self.mesh.shape[meshlib.CLIENT_AXIS]
 
+        # The round program is shard_mapped manually over the ``clients`` axis:
+        # each device runs an ordinary vmap over its local cohort slice, then
+        # the client stacks are all-gathered for the aggregator. (Leaving the
+        # client axis to GSPMD instead hits an XLA limitation: vmap expresses
+        # per-client conv kernel gradients as feature-grouped convolutions,
+        # which the SPMD partitioner cannot split along the group axis.)
+        # Other mesh axes (e.g. ``silo`` intra-client DP) stay automatic.
+        from jax.sharding import PartitionSpec as P
+
+        cohort_spec = P(meshlib.CLIENT_AXIS)
         self._round_fn = jax.jit(
-            self._round_impl,
+            jax.shard_map(
+                self._round_impl,
+                mesh=self.mesh,
+                in_specs=(P(), P(), cohort_spec, cohort_spec, P()),
+                out_specs=(P(), P(), P()),
+                axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                check_vma=False,
+            ),
             donate_argnums=(0,),
         )
         self._eval_fn = jax.jit(self._eval_impl)
@@ -103,16 +121,30 @@ class FedSim:
     # -- jitted programs -----------------------------------------------------
 
     def _round_impl(self, global_variables, server_state, batches, weights, rng):
-        keys = jax.random.split(rng, weights.shape[0])
+        # Runs per client-shard: ``batches``/``weights`` carry this device's
+        # local cohort slice [C_local, ...]. Per-client rng keys are derived
+        # from the *global* client slot so results are mesh-shape-invariant.
+        from fedml_tpu.parallel.mesh import CLIENT_AXIS
+
+        c_local = weights.shape[0]
+        shard_idx = jax.lax.axis_index(CLIENT_AXIS)
+        slot_ids = shard_idx * c_local + jnp.arange(c_local)
+        keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(slot_ids)
         local_vars, train_metrics = jax.vmap(
             self._local_train, in_axes=(None, 0, 0)
         )(global_variables, batches, keys)
+        # Full cohort stack for the aggregator (robust rules need every
+        # client's model: median/krum/clipping are cross-client).
+        gather = partial(jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True)
+        stacked = jax.tree.map(gather, local_vars)
+        all_weights = gather(weights)
+        all_losses = gather(train_metrics["train_loss"])
         new_global, server_state, agg_metrics = self.aggregator.aggregate(
-            global_variables, local_vars, weights, server_state, rng
+            global_variables, stacked, all_weights, server_state, rng
         )
         metrics = {
             "Train/Loss": jnp.sum(
-                train_metrics["train_loss"] * weights / jnp.sum(weights)
+                all_losses * all_weights / jnp.sum(all_weights)
             ),
             **agg_metrics,
         }
@@ -167,7 +199,9 @@ class FedSim:
             }
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
         batches = jax.device_put(batches, self._shard)
-        weights = jax.device_put(jnp.asarray(weights), self._rep)
+        weights = jax.device_put(
+            jnp.asarray(weights), meshlib.client_sharded(self.mesh)
+        )
         return cohort, batches, weights
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
