@@ -18,19 +18,39 @@ from fedml_tpu.comm.message import Message
 
 
 def create_backend(backend: str, rank: int, world_size: int, **kw) -> BaseCommunicationManager:
-    """Backend mux (client_manager.py:28-50 equivalent): loopback | shm | grpc."""
+    """Backend mux (client_manager.py:28-50 equivalent):
+    loopback | shm | grpc | mqtt, each optionally composed with an object
+    store for large payloads (``store_dir=...`` — the MQTT_S3 production
+    pattern for any transport)."""
     if backend == "loopback":
-        return _loopback(kw, rank)
-    if backend == "shm":
+        mgr = _loopback(kw, rank)
+    elif backend == "shm":
         from fedml_tpu.comm.shm import ShmCommManager
 
-        return ShmCommManager(kw.get("job", "fedml"), rank, world_size)
-    if backend == "grpc":
+        mgr = ShmCommManager(kw.get("job", "fedml"), rank, world_size)
+    elif backend == "grpc":
         from fedml_tpu.comm.grpc_backend import GRPCCommManager, read_ip_config
 
         ip_config = kw.get("ip_config") or read_ip_config(kw["ip_config_path"])
-        return GRPCCommManager(rank, ip_config)
-    raise ValueError(f"unknown backend {backend!r}")
+        mgr = GRPCCommManager(rank, ip_config)
+    elif backend == "mqtt":
+        from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+        mgr = MqttCommManager(
+            kw.get("mqtt_host", "localhost"), kw.get("mqtt_port", 1883),
+            topic=kw.get("job", "fedml"), client_id=rank,
+            client_num=world_size - 1,
+        )
+    else:
+        raise ValueError(f"unknown backend {backend!r}")
+    if kw.get("store_dir"):
+        from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+        mgr = OffloadCommManager(
+            mgr, FileSystemStore(kw["store_dir"]),
+            threshold_bytes=kw.get("store_threshold", 1 << 16),
+        )
+    return mgr
 
 
 def _loopback(kw, rank):

@@ -1,0 +1,113 @@
+"""MQTT broker backend for edge-device federation.
+
+Reference: fedml_core/distributed/communication/mqtt/mqtt_comm_manager.py:14 —
+broker pub/sub with the topic scheme: the server (id 0) publishes
+``<topic>0_<clientID>`` and subscribes ``<topic><clientID>``; clients do the
+inverse (:47-70, 99-120). The reference ships full JSON payloads inline; here
+messages use the typed binary wire format (Message.to_bytes) and large model
+payloads ride the object store via OffloadCommManager
+(fedml_tpu/comm/object_store.py) — the MQTT_S3 production combination.
+
+Also carried over: the last-will "offline" status message
+(mqtt_s3_multi_clients_comm_manager.py:71-72) on the status topic consumed by
+comm.status.
+
+paho-mqtt is imported lazily — constructing without it installed raises a
+clear error; the rest of the framework never imports this module implicitly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import threading
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+
+
+class MqttCommManager(BaseCommunicationManager):
+    def __init__(self, host: str, port: int, topic: str = "fedml",
+                 client_id: int = 0, client_num: int = 0,
+                 status_topic: str | None = None, keepalive: int = 180):
+        try:
+            import paho.mqtt.client as mqtt
+        except ImportError as e:
+            raise ImportError(
+                "MqttCommManager requires paho-mqtt (not in this image); "
+                "use the loopback/shm/grpc backends instead"
+            ) from e
+        super().__init__()
+        self._mqtt = mqtt
+        self.topic = topic
+        self.client_id = client_id
+        self.client_num = client_num
+        self.status_topic = status_topic or f"{topic}/status"
+        self._stop = threading.Event()
+        self._q: queue.Queue = queue.Queue()
+
+        self.client = mqtt.Client(client_id=f"{topic}-{client_id}", protocol=mqtt.MQTTv311)
+        # last-will: broker announces our death on the status topic
+        self.client.will_set(
+            self.status_topic,
+            json.dumps({"id": client_id, "status": "OFFLINE"}),
+            qos=1, retain=False,
+        )
+        self.client.on_connect = self._on_connect
+        self.client.on_message = self._on_message
+        self.client.connect(host, port, keepalive)
+        self.client.loop_start()
+
+    # topic scheme (mqtt_comm_manager.py:47-70)
+    def _send_topic(self, receiver_id: int) -> str:
+        if self.client_id == 0:
+            return f"{self.topic}0_{receiver_id}"
+        return f"{self.topic}{self.client_id}"
+
+    def _recv_topic(self) -> str:
+        if self.client_id == 0:
+            # server subscribes to every client's topic via wildcard-free loop
+            return None  # handled in _on_connect
+        return f"{self.topic}0_{self.client_id}"
+
+    def _on_connect(self, client, userdata, flags, rc):
+        if self.client_id == 0:
+            for cid in range(1, self.client_num + 1):
+                client.subscribe(f"{self.topic}{cid}", qos=1)
+        else:
+            client.subscribe(self._recv_topic(), qos=1)
+        client.publish(
+            self.status_topic,
+            json.dumps({"id": self.client_id, "status": "ONLINE"}),
+            qos=1,
+        )
+
+    def _on_message(self, client, userdata, mqtt_msg):
+        try:
+            self._q.put(Message.from_bytes(mqtt_msg.payload))
+        except Exception:
+            logging.exception("mqtt: undecodable message on %s", mqtt_msg.topic)
+
+    def send_message(self, msg: Message) -> None:
+        topic = self._send_topic(msg.get_receiver_id())
+        info = self.client.publish(topic, msg.to_bytes(), qos=1)
+        info.wait_for_publish()
+
+    def handle_receive_message(self) -> None:
+        while not self._stop.is_set():
+            try:
+                msg = self._q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self.notify(msg)
+
+    def stop_receive_message(self) -> None:
+        self._stop.set()
+        self.client.publish(
+            self.status_topic,
+            json.dumps({"id": self.client_id, "status": "FINISHED"}),
+            qos=1,
+        )
+        self.client.loop_stop()
+        self.client.disconnect()

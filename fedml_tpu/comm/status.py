@@ -1,0 +1,75 @@
+"""Client liveness / status protocol for cross-silo deployments.
+
+Reference: the ONLINE/FINISHED client-status handshake in
+fedavg_cross_silo/ClientMasterManager.py:65-77 (CONNECTION_IS_READY →
+send_client_status ONLINE) and :169-188 (FINISHED on completion), plus
+MqttS3StatusManager's JSON status pub/sub (mqtt_s3_status_manager.py:17) and
+the MQTT last-will offline signal. The reference only has liveness on the
+MQTT path; here the protocol is transport-agnostic: status is an ordinary
+typed message on any backend.
+
+The server holds a ClientStatusTracker and starts the round protocol once
+every expected client reported ONLINE — replacing the reference's implicit
+"MPI processes all exist" assumption with an explicit, failure-aware
+handshake.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import Message
+
+
+class ClientStatus:
+    MSG_TYPE_CLIENT_STATUS = 7001  # reserved type id for status messages
+
+    ONLINE = "ONLINE"
+    FINISHED = "FINISHED"
+    OFFLINE = "OFFLINE"
+
+    KEY_STATUS = "client_status"
+    KEY_OS = "client_os"  # reference tags client OS in status msgs (message.py:21-24)
+
+
+def send_client_status(comm: BaseCommunicationManager, client_id: int,
+                       status: str, receiver_id: int = 0) -> None:
+    """Reference ClientMasterManager.send_client_status(:169)."""
+    msg = Message(ClientStatus.MSG_TYPE_CLIENT_STATUS, client_id, receiver_id)
+    msg.add_params(ClientStatus.KEY_STATUS, status)
+    msg.add_params(ClientStatus.KEY_OS, "linux-tpu")
+    comm.send_message(msg)
+
+
+class ClientStatusTracker:
+    """Server-side liveness table; thread-safe (the reference's unsynchronized
+    status dicts are a known hazard, SURVEY §5.2)."""
+
+    def __init__(self, expected_clients: int):
+        self.expected = expected_clients
+        self._status: dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._all_online = threading.Event()
+
+    def update(self, client_id: int, status: str) -> None:
+        with self._lock:
+            self._status[client_id] = status
+            online = sum(1 for s in self._status.values() if s == ClientStatus.ONLINE)
+            if online >= self.expected:
+                self._all_online.set()
+
+    def handle_message(self, msg: Message) -> None:
+        self.update(msg.get_sender_id(), msg.get(ClientStatus.KEY_STATUS))
+
+    def wait_all_online(self, timeout: float | None = None) -> bool:
+        return self._all_online.wait(timeout)
+
+    def snapshot(self) -> dict[int, str]:
+        with self._lock:
+            return dict(self._status)
+
+    def finished_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._status.values() if s == ClientStatus.FINISHED)

@@ -1,2 +1,5 @@
 from fedml_tpu.data.registry import FedDataset, load_partition_data
 from fedml_tpu.data.synthetic import gaussian_blobs, synthetic_classification
+from fedml_tpu.data.uci import load_streaming
+from fedml_tpu.data.vertical_tabular import load_vertical
+from fedml_tpu.data.poison import Trigger, backdoor_test_arrays, poison_clients

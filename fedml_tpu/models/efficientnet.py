@@ -1,0 +1,159 @@
+"""EfficientNet b0–b8 (reference: fedml_api/model/cv/efficientnet.py:138 +
+efficientnet_utils.py — the torch port of the official TF implementation).
+
+TPU-first Flax rewrite: MBConv inverted-residual blocks with squeeze-excite,
+SiLU (swish) activations, GroupNorm instead of BatchNorm (federated clients
+averaging BN statistics is the reference's known pain point — SURVEY §7), and
+NHWC layouts so every conv is an MXU matmul. Compound scaling follows the
+paper's (width, depth, resolution, dropout) coefficients — the same table the
+reference's ``efficientnet_params`` carries (efficientnet_utils.py).
+
+Stochastic depth (drop-connect) is applied per block when ``train=True``.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+# (width_coefficient, depth_coefficient, resolution, dropout_rate) — reference
+# efficientnet_utils.efficientnet_params
+SCALING = {
+    "efficientnet-b0": (1.0, 1.0, 224, 0.2),
+    "efficientnet-b1": (1.0, 1.1, 240, 0.2),
+    "efficientnet-b2": (1.1, 1.2, 260, 0.3),
+    "efficientnet-b3": (1.2, 1.4, 300, 0.3),
+    "efficientnet-b4": (1.4, 1.8, 380, 0.4),
+    "efficientnet-b5": (1.6, 2.2, 456, 0.4),
+    "efficientnet-b6": (1.8, 2.6, 528, 0.5),
+    "efficientnet-b7": (2.0, 3.1, 600, 0.5),
+    "efficientnet-b8": (2.2, 3.6, 672, 0.5),
+}
+
+# (expand_ratio, channels, repeats, stride, kernel) — the 7-stage b0 backbone
+BASE_BLOCKS = (
+    (1, 16, 1, 1, 3),
+    (6, 24, 2, 2, 3),
+    (6, 40, 2, 2, 5),
+    (6, 80, 3, 2, 3),
+    (6, 112, 3, 1, 5),
+    (6, 192, 4, 2, 5),
+    (6, 320, 1, 1, 3),
+)
+
+
+def round_filters(filters: int, width: float, divisor: int = 8) -> int:
+    filters *= width
+    new = max(divisor, int(filters + divisor / 2) // divisor * divisor)
+    if new < 0.9 * filters:
+        new += divisor
+    return int(new)
+
+
+def round_repeats(repeats: int, depth: float) -> int:
+    return int(math.ceil(depth * repeats))
+
+
+def _gn_groups(c: int, target: int = 8) -> int:
+    g = min(target, c)
+    while c % g:
+        g -= 1
+    return g
+
+
+class SqueezeExcite(nn.Module):
+    features: int
+    se_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x):
+        squeezed = max(1, int(self.features * self.se_ratio))
+        s = jnp.mean(x, axis=(1, 2), keepdims=True)
+        s = nn.Conv(squeezed, (1, 1))(s)
+        s = nn.silu(s)
+        s = nn.Conv(x.shape[-1], (1, 1))(s)
+        return x * nn.sigmoid(s)
+
+
+class MBConv(nn.Module):
+    out_features: int
+    expand_ratio: int
+    stride: int
+    kernel: int
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inp = x.shape[-1]
+        h = x
+        if self.expand_ratio != 1:
+            h = nn.Conv(inp * self.expand_ratio, (1, 1), use_bias=False)(h)
+            h = nn.GroupNorm(num_groups=_gn_groups(inp * self.expand_ratio))(h)
+            h = nn.silu(h)
+        # depthwise
+        c = h.shape[-1]
+        h = nn.Conv(c, (self.kernel, self.kernel), strides=self.stride,
+                    padding="SAME", feature_group_count=c, use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(c))(h)
+        h = nn.silu(h)
+        h = SqueezeExcite(inp)(h)
+        h = nn.Conv(self.out_features, (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(self.out_features))(h)
+        if self.stride == 1 and inp == self.out_features:
+            if self.drop_rate > 0.0 and train:
+                # stochastic depth on the residual branch
+                keep = 1.0 - self.drop_rate
+                rng = self.make_rng("dropout")
+                mask = jax.random.bernoulli(rng, keep, (h.shape[0], 1, 1, 1))
+                h = jnp.where(mask, h / keep, 0.0)
+            h = h + x
+        return h
+
+
+class EfficientNet(nn.Module):
+    num_classes: int = 10
+    width: float = 1.0
+    depth: float = 1.0
+    dropout_rate: float = 0.2
+    drop_connect_rate: float = 0.2
+    stem_features: int = 32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(round_filters(self.stem_features, self.width), (3, 3),
+                    strides=2, padding="SAME", use_bias=False)(x)
+        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]))(h)
+        h = nn.silu(h)
+
+        total_blocks = sum(round_repeats(r, self.depth) for _, _, r, _, _ in BASE_BLOCKS)
+        block_idx = 0
+        for expand, feats, repeats, stride, kernel in BASE_BLOCKS:
+            feats = round_filters(feats, self.width)
+            for i in range(round_repeats(repeats, self.depth)):
+                h = MBConv(
+                    out_features=feats,
+                    expand_ratio=expand,
+                    stride=stride if i == 0 else 1,
+                    kernel=kernel,
+                    drop_rate=self.drop_connect_rate * block_idx / total_blocks,
+                )(h, train=train)
+                block_idx += 1
+
+        h = nn.Conv(round_filters(1280, self.width), (1, 1), use_bias=False)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]))(h)
+        h = nn.silu(h)
+        h = jnp.mean(h, axis=(1, 2))
+        if self.dropout_rate:
+            h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
+        return nn.Dense(self.num_classes)(h)
+
+
+def efficientnet(name: str = "efficientnet-b0", num_classes: int = 10) -> EfficientNet:
+    """Factory matching the reference's ``EfficientNet.from_name`` dispatch."""
+    width, depth, _res, dropout = SCALING[name]
+    return EfficientNet(num_classes=num_classes, width=width, depth=depth,
+                        dropout_rate=dropout)

@@ -42,6 +42,11 @@ def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
         return MobileNet(num_classes=output_dim)
     if model_name == "mobilenet_v3":
         return MobileNetV3(num_classes=output_dim, mode="large")
+    if model_name.startswith("efficientnet"):
+        from fedml_tpu.models.efficientnet import efficientnet
+
+        name = model_name if "-" in model_name else "efficientnet-b0"
+        return efficientnet(name, num_classes=output_dim)
     if model_name == "unet":
         from fedml_tpu.models.segmentation import UNet
 

@@ -190,3 +190,80 @@ def test_distributed_fedavg_loopback_end_to_end():
 
     for a, b_ in zip(jax.tree_util.tree_leaves(final), jax.tree_util.tree_leaves(sim_vars)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5)
+
+
+def test_object_store_offload_roundtrip(tmp_path):
+    """Large arrays ride the object store; small params stay inline
+    (MQTT_S3 pattern, mqtt_s3_multi_clients_comm_manager.py:178-249)."""
+    from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+    fabric = LoopbackFabric(2)
+    store = FileSystemStore(tmp_path / "store")
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+            mgr1.stop_receive_message()
+
+    inner1 = LoopbackCommManager(fabric, 1)
+    mgr1 = OffloadCommManager(inner1, store, threshold_bytes=1024)
+    mgr1.add_observer(Obs())
+    inner0 = LoopbackCommManager(fabric, 0)
+    mgr0 = OffloadCommManager(inner0, store, threshold_bytes=1024)
+
+    big = np.arange(4096, dtype=np.float32).reshape(64, 64)
+    small = np.ones(4, np.int64)
+    msg = Message(5, 0, 1)
+    msg.add_params("big", big)
+    msg.add_params("small", small)
+    mgr0.send_message(msg)
+    mgr1.handle_receive_message()
+
+    assert len(got) == 1
+    np.testing.assert_array_equal(got[0].get("big"), big)
+    assert got[0].get("big").dtype == np.float32
+    np.testing.assert_array_equal(got[0].get("small"), small)
+    assert "__offloaded__" not in got[0].msg_params
+    # cleanup=True: blobs deleted after resolution
+    assert list((tmp_path / "store").glob("big-*")) == []
+
+
+def test_client_status_tracker():
+    from fedml_tpu.comm.status import ClientStatus, ClientStatusTracker, send_client_status
+
+    fabric = LoopbackFabric(3)
+    tracker = ClientStatusTracker(expected_clients=2)
+    server = LoopbackCommManager(fabric, 0)
+
+    class Obs:
+        def __init__(self):
+            self.n = 0
+        def receive_message(self, t, m):
+            assert t == ClientStatus.MSG_TYPE_CLIENT_STATUS
+            tracker.handle_message(m)
+            self.n += 1
+            if self.n >= 3:
+                server.stop_receive_message()
+
+    server.add_observer(Obs())
+    c1 = LoopbackCommManager(fabric, 1)
+    c2 = LoopbackCommManager(fabric, 2)
+    send_client_status(c1, 1, ClientStatus.ONLINE)
+    send_client_status(c2, 2, ClientStatus.ONLINE)
+    send_client_status(c1, 1, ClientStatus.FINISHED)
+    server.handle_receive_message()
+
+    assert tracker.wait_all_online(timeout=1.0)
+    assert tracker.finished_count() == 1
+    snap = tracker.snapshot()
+    assert snap[2] == ClientStatus.ONLINE and snap[1] == ClientStatus.FINISHED
+
+
+def test_mqtt_backend_gated():
+    import pytest
+
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+    with pytest.raises(ImportError, match="paho-mqtt"):
+        MqttCommManager("localhost", 1883)

@@ -152,3 +152,35 @@ def test_cnn_trains_one_step():
         jax.tree.map(lambda a, b: jnp.abs(a - b).sum(), variables["batch_stats"], new_vars["batch_stats"])
     )
     assert sum(float(d) for d in diff) > 0
+
+
+def test_efficientnet_b0():
+    from fedml_tpu.models.efficientnet import efficientnet
+
+    x = jnp.ones((2, 32, 32, 3))
+    # reference b0 ~5.3M params (efficientnet.py:138 torch port); GN-instead-of-
+    # BN shifts the count slightly
+    variables, out = _init_and_apply(efficientnet("efficientnet-b0", 10), x, 5_300_000)
+    assert out.shape == (2, 10)
+    assert "batch_stats" not in variables
+
+
+def test_efficientnet_scaling():
+    from fedml_tpu.models.efficientnet import efficientnet
+    from fedml_tpu.core.tree import tree_size
+
+    x = jnp.ones((1, 32, 32, 3))
+    n0 = tree_size(
+        efficientnet("efficientnet-b0", 10).init({"params": KEY, "dropout": KEY}, x, train=False)["params"]
+    )
+    n2 = tree_size(
+        efficientnet("efficientnet-b2", 10).init({"params": KEY, "dropout": KEY}, x, train=False)["params"]
+    )
+    assert n2 > 1.2 * n0  # compound scaling grows the network
+
+
+def test_efficientnet_registry():
+    m = create_model("efficientnet-b1", 10)
+    x = jnp.ones((1, 32, 32, 3))
+    out = m.apply(m.init({"params": KEY, "dropout": KEY}, x, train=False), x, train=False)
+    assert out.shape == (1, 10)
