@@ -1,0 +1,84 @@
+"""Multi-chip mesh coverage: the 2-D clients × silo mesh (cohort parallelism
++ intra-silo data parallelism, the TPU analogue of the reference's in-silo DDP,
+fedavg_cross_silo/process_group_manager.py:23-27) must both execute and produce
+the same result as the 1-D client mesh — mesh-shape invariance of the round
+program. Also exercises the driver-contract entry module directly."""
+
+import jax
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    SILO_AXIS,
+    client_mesh,
+    silo_mesh,
+)
+from fedml_tpu.sim.engine import FedSim, SimConfig
+
+
+def _make_sim(mesh, n_clients=8, batch=4):
+    train, test = gaussian_blobs(
+        n_clients=n_clients, samples_per_client=4 * batch, num_classes=4,
+        dim=12, seed=0,
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2),
+        epochs=2,
+    )
+    cfg = SimConfig(
+        client_num_in_total=n_clients,
+        client_num_per_round=n_clients,
+        batch_size=batch,
+        comm_round=2,
+        epochs=2,
+        frequency_of_the_test=2,
+        seed=0,
+    )
+    return FedSim(trainer, train, test, cfg, mesh=mesh)
+
+
+def test_silo_mesh_round_executes():
+    # silo_mesh(2): one client slot per silo, remaining devices = in-silo DP
+    mesh = silo_mesh(2)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        CLIENT_AXIS: 2,
+        SILO_AXIS: 4,
+    }
+    sim = _make_sim(mesh)
+    variables, history = sim.run()
+    assert np.isfinite(history[-1]["Train/Loss"])
+    assert history[-1]["Train/Acc"] > 0.25  # learns past chance on blobs
+
+
+def test_silo_mesh_matches_client_mesh():
+    """Round program is mesh-shape invariant: per-client rng keys are derived
+    from global slot ids, so 8×1 and 4×2 meshes compute identical rounds."""
+    v1, h1 = _make_sim(client_mesh()).run()
+    v2, h2 = _make_sim(silo_mesh(2)).run()
+    leaves1 = jax.tree.leaves(v1)
+    leaves2 = jax.tree.leaves(v2)
+    for a, b in zip(leaves1, leaves2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+    assert h1[-1]["Train/Loss"] == pytest.approx(h2[-1]["Train/Loss"], abs=1e-5)
+
+
+def test_silo_mesh_four_way():
+    """2×4 layout: fewer client shards, wider in-silo DP."""
+    sim = _make_sim(silo_mesh(4))
+    variables, history = sim.run()
+    assert np.isfinite(history[-1]["Train/Loss"])
+
+
+def test_graft_entry_single_chip():
+    """entry() must return a jittable forward on flagship shapes."""
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
