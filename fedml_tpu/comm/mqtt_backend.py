@@ -47,7 +47,16 @@ class MqttCommManager(BaseCommunicationManager):
         self._stop = threading.Event()
         self._q: queue.Queue = queue.Queue()
 
-        self.client = mqtt.Client(client_id=f"{topic}-{client_id}", protocol=mqtt.MQTTv311)
+        if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+            self.client = mqtt.Client(
+                mqtt.CallbackAPIVersion.VERSION1,
+                client_id=f"{topic}-{client_id}",
+                protocol=mqtt.MQTTv311,
+            )
+        else:
+            self.client = mqtt.Client(
+                client_id=f"{topic}-{client_id}", protocol=mqtt.MQTTv311
+            )
         # last-will: broker announces our death on the status topic
         self.client.will_set(
             self.status_topic,

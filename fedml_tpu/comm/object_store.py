@@ -125,16 +125,21 @@ class OffloadCommManager(BaseCommunicationManager):
     # -- send path ----------------------------------------------------------
 
     def send_message(self, msg: Message) -> None:
+        # Work on a shallow copy: the caller's Message must stay intact so it
+        # can be reused for further receivers (each send uploads fresh blobs,
+        # which matters with cleanup=True — the first receiver deletes them).
         offloaded: dict[str, str] = {}
-        for k, v in list(msg.msg_params.items()):
+        out = Message()
+        out.msg_params = dict(msg.msg_params)
+        for k, v in list(out.msg_params.items()):
             if isinstance(v, np.ndarray) and v.nbytes >= self.threshold:
                 key = f"{k}-{uuid.uuid4().hex}"
                 self.store.put(key, _array_bytes(v))
                 offloaded[k] = key
-                del msg.msg_params[k]
+                del out.msg_params[k]
         if offloaded:
-            msg.add_params(_OFFLOADED, offloaded)
-        self.inner.send_message(msg)
+            out.add_params(_OFFLOADED, offloaded)
+        self.inner.send_message(out)
 
     # -- receive path -------------------------------------------------------
 

@@ -108,23 +108,36 @@ def _pixel_mask(batch: Batch, ce: jnp.ndarray) -> jnp.ndarray:
     return jnp.broadcast_to(m, ce.shape)
 
 
+def _masked_seg_ce(logits: jnp.ndarray, batch: Batch):
+    """Shared validity contract for the segmentation loss AND metrics: labels
+    outside [0, C) (e.g. the 255 ignore label, reference fedseg/utils.py
+    Evaluator.add_batch's (gt >= 0) & (gt < num_class)) leave the mask, and CE
+    runs on clipped labels — out-of-range labels yield inf, and inf * 0-mask
+    is NaN. Returns (ce, mask, clipped labels)."""
+    num_classes = logits.shape[-1]
+    y = batch["y"]
+    valid = ((y >= 0) & (y < num_classes)).astype(jnp.float32)
+    y_safe = jnp.clip(y, 0, num_classes - 1)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, y_safe)
+    m = _pixel_mask(batch, ce) * valid
+    return ce, m, y_safe
+
+
 def segmentation_loss(logits: jnp.ndarray, batch: Batch) -> jnp.ndarray:
     """Per-pixel CE for [B, H, W, C] logits vs [B, H, W] int labels
     (reference fedml_api/distributed/fedseg/utils.py SegmentationLosses.CELoss)."""
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
-    m = _pixel_mask(batch, ce)
+    ce, m, _ = _masked_seg_ce(logits, batch)
     return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
 def segmentation_metrics(logits: jnp.ndarray, batch: Batch) -> dict[str, jnp.ndarray]:
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, batch["y"])
     pred = jnp.argmax(logits, -1)
-    m = _pixel_mask(batch, ce)
-    correct = (pred == batch["y"]).astype(jnp.float32)
     num_classes = logits.shape[-1]
+    ce, m, y_safe = _masked_seg_ce(logits, batch)
+    correct = (pred == batch["y"]).astype(jnp.float32)
     # confusion matrix [C, C] (true, pred) — the fedseg Evaluator's core
     # (reference fedseg/utils.py Evaluator.add_batch confusion accumulation)
-    idx = batch["y"] * num_classes + pred
+    idx = y_safe * num_classes + pred  # in-bounds even for ignored labels (masked to 0)
     conf = jnp.zeros((num_classes * num_classes,), jnp.float32).at[idx.ravel()].add(m.ravel())
     return {
         "test_correct": jnp.sum(correct * m),

@@ -162,7 +162,10 @@ def _blockwise_bwd(q, k, v, out, g, causal, sm_scale, block_k):
             k_pos = j * block_k + jnp.arange(block_k)
             s = jnp.where((k_pos[None] <= q_pos[:, None])[None, None], s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        l = l * jnp.exp(m - m_new) + jnp.sum(jnp.exp(s - m_new), axis=-1, keepdims=True)
+        # masked entries must contribute 0, not exp(NEG_INF - NEG_INF) = 1
+        # (NEG_INF is finite; a fully masked row keeps m_new at NEG_INF)
+        e = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - m_new))
+        l = l * jnp.exp(m - m_new) + jnp.sum(e, axis=-1, keepdims=True)
         return (m_new, l), None
 
     m0 = jnp.full((b, h, t_q, 1), NEG_INF, jnp.float32)
@@ -180,7 +183,9 @@ def _blockwise_bwd(q, k, v, out, g, causal, sm_scale, block_k):
         if causal:
             k_pos = j * block_k + jnp.arange(block_k)
             s = jnp.where((k_pos[None] <= q_pos[:, None])[None, None], s, NEG_INF)
-        p = jnp.exp(s - lse)  # [b,h,t_q,block_k]
+        # zero masked entries like the forward kernel does — for a fully
+        # masked row lse is ~NEG_INF too and exp(s - lse) would be O(1)
+        p = jnp.where(s <= NEG_INF / 2, 0.0, jnp.exp(s - lse))  # [b,h,t_q,block_k]
         dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v_blk)
         ds = p * (dp - delta) * sm_scale
         dq = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, k_blk)

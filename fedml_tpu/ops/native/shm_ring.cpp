@@ -17,8 +17,11 @@
 
 #include <cerrno>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <ctime>
+#include <string>
+#include <sys/file.h>
 #include <fcntl.h>
 #include <pthread.h>
 #include <sys/mman.h>
@@ -78,6 +81,39 @@ void ring_read(Ring* r, uint8_t* dst, uint64_t len) {
 
 }  // namespace
 
+namespace {
+
+// Wait budget (ms) for init/recovery waits; FEDML_SHMRING_WAIT_MS overrides
+// (tests use tiny budgets so the timeout paths don't cost seconds).
+int wait_budget_ms(int def_ms) {
+  const char* s = getenv("FEDML_SHMRING_WAIT_MS");
+  if (!s) return def_ms;
+  int v = atoi(s);
+  return v > 0 ? v : def_ms;
+}
+
+// Whether the segment's magic word is already published — i.e. the segment is
+// fully initialized and must NOT be unlinked by stale-segment recovery.
+bool magic_published(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return false;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || (size_t)st.st_size < sizeof(Header)) {
+    close(fd);
+    return false;
+  }
+  void* mem = mmap(nullptr, sizeof(Header), PROT_READ, MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return false;
+  // plain atomic load — an RMW (__sync_fetch_and_add) would store and fault
+  // on this read-only mapping
+  bool ok = __atomic_load_n(&((Header*)mem)->magic, __ATOMIC_SEQ_CST) == kMagic;
+  munmap(mem, sizeof(Header));
+  return ok;
+}
+
+}  // namespace
+
 extern "C" {
 
 void* shmring_try_create(const char* name, uint64_t capacity);
@@ -86,11 +122,40 @@ void* shmring_create(const char* name, uint64_t capacity) {
   void* r = shmring_try_create(name, capacity);
   if (r) return r;
   // Attach timed out: a creator died between O_EXCL and magic publication,
-  // leaving a stale half-initialized segment. Unlink it and retry once —
-  // this restores the old check-magic-and-reinit self-healing without its
-  // concurrent-init race.
-  shm_unlink(name);
-  return shmring_try_create(name, capacity);
+  // leaving a stale half-initialized segment. Recovery must not race: two
+  // attachers timing out together could otherwise each unlink + recreate and
+  // end up mapped to distinct rings under one name. So (a) never unlink a
+  // segment whose magic is now published — just re-attach; (b) elect a single
+  // recoverer with an O_EXCL lock segment; losers wait for it to finish.
+  if (magic_published(name)) return shmring_try_create(name, capacity);
+  // Recovery must be exclusive: serialize with flock on a dedicated lock
+  // segment. The kernel releases an flock when its holder dies, so a crashed
+  // recoverer can't wedge the name and no timed lock-break (which could
+  // delete a live lock and re-admit the split-ring race) is ever needed.
+  // The lock segment is deliberately never unlinked here — unlink+recreate
+  // would hand out a second lock inode and two "exclusive" holders;
+  // shmring_unlink cleans it up with the ring.
+  std::string lock = std::string(name) + ".rec";
+  int lfd = shm_open(lock.c_str(), O_CREAT | O_RDWR, 0600);
+  if (lfd < 0) return nullptr;
+  int budget = wait_budget_ms(10000);
+  bool locked = false;
+  for (int i = 0; i <= budget; ++i) {
+    if (flock(lfd, LOCK_EX | LOCK_NB) == 0) {
+      locked = true;
+      break;
+    }
+    usleep(1000);
+  }
+  if (!locked) {
+    close(lfd);
+    return nullptr;
+  }
+  if (!magic_published(name)) shm_unlink(name);  // re-check under the lock
+  r = shmring_try_create(name, capacity);
+  flock(lfd, LOCK_UN);
+  close(lfd);
+  return r;
 }
 
 void* shmring_try_create(const char* name, uint64_t capacity) {
@@ -106,7 +171,8 @@ void* shmring_try_create(const char* name, uint64_t capacity) {
     if (fd < 0) return nullptr;
     // wait for the creator to size the segment (ftruncate not yet done)
     struct stat st;
-    for (int i = 0; i < 2000; ++i) {
+    int budget = wait_budget_ms(2000);
+    for (int i = 0; i < budget; ++i) {
       if (fstat(fd, &st) != 0) {
         close(fd);
         return nullptr;
@@ -142,7 +208,8 @@ void* shmring_try_create(const char* name, uint64_t capacity) {
     __sync_synchronize();
     h->magic = kMagic;
   } else {
-    for (int i = 0; i < 2000 && __sync_fetch_and_add(&h->magic, 0) != kMagic; ++i)
+    int budget = wait_budget_ms(2000);
+    for (int i = 0; i < budget && __sync_fetch_and_add(&h->magic, 0) != kMagic; ++i)
       usleep(1000);
     if (__sync_fetch_and_add(&h->magic, 0) != kMagic) {
       munmap(mem, total);
@@ -229,6 +296,9 @@ int shmring_close(void* handle) {
   return 0;
 }
 
-int shmring_unlink(const char* name) { return shm_unlink(name); }
+int shmring_unlink(const char* name) {
+  shm_unlink((std::string(name) + ".rec").c_str());  // recovery lock, if any
+  return shm_unlink(name);
+}
 
 }  // extern "C"

@@ -103,6 +103,47 @@ def test_shm_ring_native():
         ring.unlink()
 
 
+def test_shm_ring_stale_segment_recovery():
+    """A creator that died between O_EXCL and magic publication leaves a
+    half-initialized segment; shmring_create must elect a single recoverer,
+    unlink it, and rebuild — including when a stale recovery lock from
+    another dead process is also present."""
+    import ctypes
+    import os
+
+    from fedml_tpu.comm.shm import ShmRing, _load_lib
+
+    lib = _load_lib()
+    name = f"/fedml_stale_{np.random.randint(1 << 30)}"
+    os.environ["FEDML_SHMRING_WAIT_MS"] = "50"  # don't wait out full budgets
+
+    # forge a half-initialized segment: right size, magic never published
+    libc = ctypes.CDLL(None, use_errno=True)
+    fd = libc.shm_open(name.encode(), 0o102, 0o600)  # O_CREAT|O_RDWR
+    assert fd >= 0
+    libc.ftruncate(fd, 1 << 16)
+    libc.close(fd)
+
+    # also forge a leftover recovery-lock segment (a dead recoverer's flock
+    # was already released by the kernel — the segment alone must not block)
+    lfd = libc.shm_open(f"{name}.rec".encode(), 0o102, 0o600)
+    assert lfd >= 0
+    libc.close(lfd)
+
+    try:
+        ring = ShmRing(name, capacity=1 << 12, create=True)
+        try:
+            ring.send(b"recovered")
+            assert ring.recv(timeout_ms=500) == b"recovered"
+        finally:
+            ring.close()
+            ring.unlink()
+        # shmring_unlink cleans up the recovery lock segment too
+        assert libc.shm_open(f"{name}.rec".encode(), 2, 0o600) < 0  # O_RDWR
+    finally:
+        del os.environ["FEDML_SHMRING_WAIT_MS"]
+
+
 def test_shm_comm_manager_roundtrip():
     from fedml_tpu.comm.shm import ShmCommManager
 
@@ -227,6 +268,15 @@ def test_object_store_offload_roundtrip(tmp_path):
     assert "__offloaded__" not in got[0].msg_params
     # cleanup=True: blobs deleted after resolution
     assert list((tmp_path / "store").glob("big-*")) == []
+    # send_message must not mutate the caller's Message: the same object is
+    # reusable for a second receiver (fresh blobs per send, so the first
+    # receiver's cleanup can't dangle the second's reference)
+    assert "big" in msg.msg_params and "__offloaded__" not in msg.msg_params
+    got.clear()
+    mgr0.send_message(msg)
+    mgr1.handle_receive_message()  # consumes the stop sentinel from phase 1
+    mgr1.handle_receive_message()
+    np.testing.assert_array_equal(got[0].get("big"), big)
 
 
 def test_client_status_tracker():

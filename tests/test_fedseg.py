@@ -76,3 +76,29 @@ def test_fedseg_end_to_end(rng):
     assert 0.0 <= global_m["Eval/mIoU"] <= 1.0
     # the toy task is learnable: pixel accuracy should beat chance (1/3)
     assert global_m["Eval/PixelAcc"] > 0.4
+
+
+def test_segmentation_metrics_ignore_label():
+    """Labels outside [0, C) (e.g. the 255 ignore label) must be excluded
+    from the confusion matrix AND acc/loss denominators — every metric agrees
+    on the valid-pixel set (reference fedseg/utils.py Evaluator.add_batch's
+    (gt >= 0) & (gt < num_class) mask)."""
+    from fedml_tpu.core.trainer import segmentation_loss, segmentation_metrics
+
+    C = 3
+    logits = jnp.asarray(np.random.RandomState(0).randn(1, 2, 4, C), jnp.float32)
+    y = np.zeros((1, 2, 4), np.int32)
+    y[0, 0] = [0, 1, 2, 255]  # one ignored pixel
+    y[0, 1] = [255, 255, 1, 0]  # two more ignored
+    batch = {"x": jnp.zeros((1, 2, 4, 1)), "y": jnp.asarray(y),
+             "mask": jnp.ones((1,), jnp.float32)}
+    m = segmentation_metrics(logits, batch)
+    assert float(m["test_total"]) == 5.0  # 8 pixels - 3 ignored
+    assert float(jnp.sum(m["confusion"])) == 5.0
+    assert np.isfinite(float(m["test_loss"]))
+    # loss over the same valid set: matches a hand-masked computation
+    valid = (y >= 0) & (y < C)
+    import optax as _optax
+    ce = _optax.softmax_cross_entropy_with_integer_labels(logits, jnp.asarray(np.clip(y, 0, C - 1)))
+    want = float(jnp.sum(ce * valid) / valid.sum())
+    assert float(segmentation_loss(logits, batch)) == pytest.approx(want, rel=1e-5)

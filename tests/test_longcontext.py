@@ -137,3 +137,34 @@ def test_transformer_in_fed_sim(rng):
     _, history = sim.run()
     assert len(history) == 2
     assert np.isfinite(history[-1]["Train/Loss"])
+
+
+def test_flash_bwd_fully_masked_rows(rng):
+    """Causal cross-attention with t_q > t_k right-aligns the key window, so
+    the first t_q - t_k query rows attend to nothing. The forward kernel
+    zeroes those rows; the blockwise backward must produce zero (not O(1)
+    garbage from exp(NEG_INF - NEG_INF)) gradients through them, even when
+    the upstream cotangent is nonzero there."""
+    b, h, t_q, t_k, d = 1, 2, 16, 8, 8
+    q = jnp.asarray(rng.randn(b, h, t_q, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, h, t_k, d), jnp.float32)
+    cot = jnp.asarray(rng.randn(b, h, t_q, d), jnp.float32)  # nonzero everywhere
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 8, 8) * cot)
+
+    def loss_ref(q, k, v):
+        # reference with fully-masked rows forced to the kernel's zero output
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * (d ** -0.5)
+        mask = jnp.tril(jnp.ones((t_q, t_k), bool), k=t_k - t_q)
+        p = jax.nn.softmax(jnp.where(mask, s, -jnp.inf), axis=-1)
+        p = jnp.where(mask.any(-1)[:, None], p, 0.0)
+        return jnp.sum(jnp.einsum("bhqk,bhkd->bhqd", p, v) * cot)
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    n_masked = t_q - t_k
+    np.testing.assert_array_equal(np.asarray(g1[0][:, :, :n_masked]), 0.0)
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(a, b_, atol=1e-4)
