@@ -24,11 +24,14 @@ Pytree = Any
 @dataclasses.dataclass(frozen=True)
 class Aggregator:
     """``init_state(global_variables) -> state`` and
-    ``aggregate(global, stacked_locals, weights, state, rng)
+    ``aggregate(global, stacked_locals, weights, state, rng, extras=None)
     -> (new_global, new_state, metrics)``.
 
     ``stacked_locals`` leaves have shape [C, ...]; ``weights`` is [C]
     (per-client sample counts — the reference's weighting scheme).
+    ``extras`` is an optional dict of additional per-client arrays the engine
+    supplies — currently ``tau`` [C], the true local SGD step counts
+    (heterogeneous under the straggler protocol), consumed by FedNova.
     """
 
     init_state: Callable[[Pytree], Any]
@@ -42,7 +45,7 @@ def fedavg_aggregator() -> Aggregator:
     def init_state(global_variables):
         return ()
 
-    def aggregate(global_variables, stacked, weights, state, rng):
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
         new_global = treelib.tree_weighted_mean(stacked, weights)
         return new_global, state, {}
 

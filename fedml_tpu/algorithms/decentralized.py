@@ -48,7 +48,7 @@ def gossip_aggregator(mixing_matrix: np.ndarray) -> Aggregator:
     def init_state(global_variables):
         return None  # stacked per-client models, created on first round
 
-    def aggregate(global_variables, stacked, weights, state, rng):
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
         mixed = mix(stacked, W)
         mean = jax.tree.map(lambda s: jnp.mean(s, axis=0), mixed)
         return mean, mixed, {}

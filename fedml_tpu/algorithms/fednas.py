@@ -109,7 +109,7 @@ def fednas_aggregator() -> Aggregator:
     def init_state(global_variables):
         return ()
 
-    def aggregate(global_variables, stacked, weights, state, rng):
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
         return treelib.tree_weighted_mean(stacked, weights), state, {}
 
     return Aggregator(init_state, aggregate, name="fednas")

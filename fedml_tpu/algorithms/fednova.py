@@ -111,10 +111,21 @@ def fednova_aggregator(
     def init_state(global_variables):
         return ()
 
-    def aggregate(global_variables, stacked, weights, state, rng):
-        # per-client effective local steps from true sample counts
-        tau = epochs * jnp.ceil(jnp.maximum(weights, 1.0) / batch_size)
-        a = normalizing_vector(tau, momentum, etamu, max_tau)  # [C]
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
+        # per-client effective local steps: the engine passes the TRUE τ_i
+        # (heterogeneous straggler budgets, reference fednova.py:79-154
+        # semantics) via extras, together with a static "max_tau" bound for
+        # the normalizer recursion; fall back to deriving from sample counts
+        if extras is not None and "tau" in extras:
+            tau = extras["tau"]
+            mt = int(extras.get("max_tau", max_tau))
+        else:
+            tau = epochs * jnp.ceil(jnp.maximum(weights, 1.0) / batch_size)
+            mt = max_tau
+        # keep τ and a consistent even if the bound is misconfigured: a
+        # truncated recursion with un-truncated τ would silently inflate coeff
+        tau = jnp.minimum(tau, float(mt))
+        a = normalizing_vector(tau, momentum, etamu, mt)  # [C]
         p = weights / jnp.maximum(jnp.sum(weights), 1e-12)  # [C]
         tau_eff = jnp.sum(p * (tau if mu != 0.0 else a))
 

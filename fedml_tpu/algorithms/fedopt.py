@@ -46,7 +46,7 @@ def fedopt_aggregator(opt: optax.GradientTransformation) -> Aggregator:
     def init_state(global_variables):
         return opt.init(global_variables["params"])
 
-    def aggregate(global_variables, stacked, weights, opt_state, rng):
+    def aggregate(global_variables, stacked, weights, opt_state, rng, extras=None):
         avg = treelib.tree_weighted_mean(stacked, weights)
         # pseudo-gradient: old - avg (FedOptAggregator.set_model_global_grads:109-120)
         pseudo_grad = treelib.tree_sub(global_variables["params"], avg["params"])

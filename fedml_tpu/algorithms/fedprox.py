@@ -35,8 +35,8 @@ def fedprox_aggregator() -> Aggregator:
 def straggler_epochs(
     round_idx: int, cohort_size: int, epochs: int, straggler_frac: float, seed: int = 0
 ) -> np.ndarray:
-    """Per-client local-epoch counts with a straggler fraction doing fewer
-    epochs (uniform 1..E), the FedProx heterogeneity protocol."""
+    """Per-client local-epoch counts with a straggler fraction doing strictly
+    fewer epochs (uniform 1..E-1), the FedProx heterogeneity protocol."""
     rng = np.random.RandomState(seed * 77_003 + round_idx)
     out = np.full(cohort_size, epochs, dtype=np.int32)
     stragglers = rng.rand(cohort_size) < straggler_frac

@@ -69,10 +69,14 @@ class HierarchicalFedAvg:
                 # private copy so the global model survives all groups.
                 gvars = jax.tree.map(jnp.copy, variables)
                 for _ in range(hier.group_comm_round):
-                    batches, weights = self._stage(client_ids, round_counter)
+                    # shared staging: straggler budgets, padding, sharding all
+                    # behave identically to the flat engine
+                    batches, weights, num_steps = sim.stage_cohort(
+                        client_ids, round_counter
+                    )
                     rkey = rnglib.round_key(root, round_counter)
                     gvars, server_state, _ = sim._round_fn(
-                        gvars, server_state, batches, weights, rkey
+                        gvars, server_state, batches, weights, num_steps, rkey
                     )
                     round_counter += 1
                 group_models.append(gvars)
@@ -86,30 +90,3 @@ class HierarchicalFedAvg:
             history.append(rec)
         return variables, history
 
-    def _stage(self, client_ids, round_idx):
-        import numpy as np
-
-        from fedml_tpu.parallel import mesh as meshlib
-        from fedml_tpu.sim import cohort as cohortlib
-
-        cfg = self.sim.config
-        shuffle = (
-            np.random.RandomState(cfg.seed * 1_000_003 + round_idx)
-            if cfg.shuffle_each_round
-            else None
-        )
-        batches, weights = cohortlib.stack_cohort(
-            self.sim.train_data, client_ids, cfg.batch_size, steps=self.sim._steps, rng=shuffle
-        )
-        n_dev = self.sim.mesh.shape[meshlib.CLIENT_AXIS]
-        pad = (-len(client_ids)) % n_dev
-        if pad:
-            batches = {
-                k: np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
-                for k, v in batches.items()
-            }
-            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
-        return (
-            jax.device_put(batches, self.sim._shard),
-            jax.device_put(jnp.asarray(weights), self.sim._rep),
-        )

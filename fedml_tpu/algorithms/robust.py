@@ -120,7 +120,7 @@ def robust_aggregator(config: RobustConfig) -> Aggregator:
     def init_state(global_variables):
         return ()
 
-    def aggregate(global_variables, stacked, weights, state, rng):
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
         if config.norm_bound > 0:
             stacked = clip_deltas(global_variables, stacked, config.norm_bound)
         if config.rule == "median":

@@ -242,7 +242,8 @@ class ClientTrainer:
 
 
 def make_local_train(trainer: ClientTrainer):
-    """Returns ``local_train(global_variables, data, rng) -> (variables, metrics)``.
+    """Returns ``local_train(global_variables, data, rng, num_steps=None)
+    -> (variables, metrics)``.
 
     ``data`` holds one client's epoch of batches, stacked on a leading steps
     axis: ``{"x": [S, B, ...], "y": [S, B, ...], "mask": [S, B]}``. The
@@ -250,35 +251,60 @@ def make_local_train(trainer: ClientTrainer):
     nested scan — the whole thing is jit/vmap-compatible, so a cohort of C
     clients is ``vmap(local_train)`` over a [C, S, B, ...] stack.
 
+    ``num_steps`` (optional scalar, vmappable per client) bounds the local
+    work: scan steps with global index >= num_steps are masked no-ops. This
+    is the SURVEY "hard parts" mask-based early exit enabling heterogeneous
+    local-step counts (FedProx straggler protocol / FedNova per-client τ,
+    reference standalone/fednova/fednova.py:79-154) inside the one-compile
+    round program: stragglers run e_i < E epochs, i.e. num_steps = e_i · S.
+
     Replaces the reference hot loop (my_model_trainer_classification.train,
     reference standalone/fedavg/my_model_trainer_classification.py:12: Python
     for-epoch/for-batch with .to(device) per batch).
     """
 
-    def local_train(global_variables: Pytree, data: Batch, rng: jax.Array):
+    def local_train(global_variables: Pytree, data: Batch, rng: jax.Array,
+                    num_steps=None):
         global_params = global_variables["params"]
         opt_state = trainer.optimizer.init(global_variables["params"])
+        S = jax.tree.leaves(data)[0].shape[0]
 
-        def epoch_body(carry, _):
+        def epoch_body(carry, e):
             variables, opt_state, rng = carry
 
-            def step_body(carry, batch):
+            def step_body(carry, xs):
                 variables, opt_state, rng = carry
+                s, batch = xs
+                if num_steps is not None:
+                    active = ((e * S + s) < num_steps).astype(jnp.float32)
+                    batch = dict(batch)
+                    batch["mask"] = batch["mask"] * active
                 rng, step_rng = jax.random.split(rng)
                 variables, opt_state, loss = trainer.train_step(
                     variables, opt_state, global_params, batch, step_rng
                 )
-                return (variables, opt_state, rng), loss
+                # weight for the loss average: did this step see any data?
+                w = (jnp.sum(batch["mask"]) > 0).astype(jnp.float32)
+                return (variables, opt_state, rng), (loss, w)
 
-            (variables, opt_state, rng), losses = jax.lax.scan(
-                step_body, (variables, opt_state, rng), data
+            (variables, opt_state, rng), (losses, ws) = jax.lax.scan(
+                step_body, (variables, opt_state, rng), (jnp.arange(S), data)
             )
-            return (variables, opt_state, rng), jnp.mean(losses)
+            return (variables, opt_state, rng), (jnp.sum(losses * ws), jnp.sum(ws))
 
-        (variables, opt_state, rng), epoch_losses = jax.lax.scan(
-            epoch_body, (global_variables, opt_state, rng), None, length=trainer.epochs
+        (variables, opt_state, rng), (loss_sums, w_sums) = jax.lax.scan(
+            epoch_body, (global_variables, opt_state, rng), jnp.arange(trainer.epochs)
         )
-        metrics = {"train_loss": epoch_losses[-1]}
+        # mean loss over executed (unmasked) steps of the last executed epoch
+        if num_steps is None:
+            last = trainer.epochs - 1
+        else:
+            last = jnp.maximum(
+                jnp.minimum((num_steps - 1) // S, trainer.epochs - 1), 0
+            )
+        metrics = {
+            "train_loss": loss_sums[last] / jnp.maximum(w_sums[last], 1.0)
+        }
         return variables, metrics
 
     return local_train

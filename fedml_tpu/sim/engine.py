@@ -45,6 +45,11 @@ class SimConfig:
     eval_batch_size: int = 256
     seed: int = 0
     shuffle_each_round: bool = True
+    # FedProx straggler protocol: this fraction of each cohort runs a reduced
+    # uniform 1..E-1 local-epoch budget (masked early exit inside the jitted
+    # scan — the heterogeneity FedProx/FedNova were designed for, absent from
+    # the reference despite the naming, SURVEY §5.3)
+    straggler_frac: float = 0.0
 
 
 class FedSim:
@@ -100,7 +105,7 @@ class FedSim:
             jax.shard_map(
                 self._round_impl,
                 mesh=self.mesh,
-                in_specs=(P(), P(), cohort_spec, cohort_spec, P()),
+                in_specs=(P(), P(), cohort_spec, cohort_spec, cohort_spec, P()),
                 out_specs=(P(), P(), P()),
                 axis_names=frozenset({meshlib.CLIENT_AXIS}),
                 check_vma=False,
@@ -120,10 +125,12 @@ class FedSim:
 
     # -- jitted programs -----------------------------------------------------
 
-    def _round_impl(self, global_variables, server_state, batches, weights, rng):
-        # Runs per client-shard: ``batches``/``weights`` carry this device's
-        # local cohort slice [C_local, ...]. Per-client rng keys are derived
-        # from the *global* client slot so results are mesh-shape-invariant.
+    def _round_impl(self, global_variables, server_state, batches, weights,
+                    num_steps, rng):
+        # Runs per client-shard: ``batches``/``weights``/``num_steps`` carry
+        # this device's local cohort slice [C_local, ...]. Per-client rng keys
+        # are derived from the *global* client slot so results are
+        # mesh-shape-invariant.
         from fedml_tpu.parallel.mesh import CLIENT_AXIS
 
         c_local = weights.shape[0]
@@ -131,16 +138,25 @@ class FedSim:
         slot_ids = shard_idx * c_local + jnp.arange(c_local)
         keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(slot_ids)
         local_vars, train_metrics = jax.vmap(
-            self._local_train, in_axes=(None, 0, 0)
-        )(global_variables, batches, keys)
+            self._local_train, in_axes=(None, 0, 0, 0)
+        )(global_variables, batches, keys, num_steps)
         # Full cohort stack for the aggregator (robust rules need every
         # client's model: median/krum/clipping are cross-client).
         gather = partial(jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True)
         stacked = jax.tree.map(gather, local_vars)
         all_weights = gather(weights)
         all_losses = gather(train_metrics["train_loss"])
+        # true per-client SGD steps τ_i = e_i · ceil(n_i / B) — heterogeneous
+        # local work for normalized-averaging rules (FedNova τ_eff). The
+        # static max_tau keeps the normalizer recursion's loop bound
+        # consistent with these τ values regardless of aggregator config.
+        epochs_i = gather(num_steps).astype(jnp.float32) / float(self._steps)
+        tau = epochs_i * jnp.ceil(
+            jnp.maximum(all_weights, 1.0) / self.config.batch_size
+        )
+        extras = {"tau": tau, "max_tau": self.trainer.epochs * self._steps}
         new_global, server_state, agg_metrics = self.aggregator.aggregate(
-            global_variables, stacked, all_weights, server_state, rng
+            global_variables, stacked, all_weights, server_state, rng, extras
         )
         metrics = {
             "Train/Loss": jnp.sum(
@@ -172,12 +188,11 @@ class FedSim:
         sample.setdefault("mask", jnp.ones((self.config.batch_size,), jnp.float32))
         return self.trainer.init(jax.random.key(self.config.seed), sample)
 
-    def stage_round(self, round_idx: int):
-        """Sample the cohort and stage its data on device."""
+    def stage_cohort(self, cohort, round_idx: int):
+        """Stage an explicit cohort's data on device: stack, apply straggler
+        budgets, pad to the mesh's client axis, ship. Also used by
+        HierarchicalFedAvg for per-group cohorts."""
         cfg = self.config
-        cohort = rnglib.sample_clients(
-            round_idx, cfg.client_num_in_total, cfg.client_num_per_round
-        )
         shuffle = (
             np.random.RandomState(cfg.seed * 1_000_003 + round_idx)
             if cfg.shuffle_each_round
@@ -186,6 +201,17 @@ class FedSim:
         batches, weights = cohortlib.stack_cohort(
             self.train_data, cohort, cfg.batch_size, steps=self._steps, rng=shuffle
         )
+        # Per-client local-step budgets (scan-step units): stragglers run a
+        # reduced epoch count e_i, i.e. the first e_i * steps-per-epoch steps.
+        if cfg.straggler_frac > 0.0:
+            from fedml_tpu.algorithms.fedprox import straggler_epochs
+
+            epochs_arr = straggler_epochs(
+                round_idx, len(cohort), cfg.epochs, cfg.straggler_frac, cfg.seed
+            )
+        else:
+            epochs_arr = np.full(len(cohort), cfg.epochs, np.int32)
+        num_steps = (epochs_arr * self._steps).astype(np.int32)
         # Pad the cohort axis to a multiple of the mesh's client axis with
         # zero-weight dummy clients (fully masked, excluded from the weighted
         # aggregation) so the stack shards evenly over devices.
@@ -198,16 +224,30 @@ class FedSim:
                 for k, v in batches.items()
             }
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+            num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
         batches = jax.device_put(batches, self._shard)
         weights = jax.device_put(
             jnp.asarray(weights), meshlib.client_sharded(self.mesh)
         )
-        return cohort, batches, weights
+        num_steps = jax.device_put(
+            jnp.asarray(num_steps), meshlib.client_sharded(self.mesh)
+        )
+        return batches, weights, num_steps
+
+    def stage_round(self, round_idx: int):
+        """Sample the round's cohort and stage its data on device."""
+        cfg = self.config
+        cohort = rnglib.sample_clients(
+            round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+        )
+        return (cohort, *self.stage_cohort(cohort, round_idx))
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
-        _, batches, weights = self.stage_round(round_idx)
+        _, batches, weights, num_steps = self.stage_round(round_idx)
         rkey = rnglib.round_key(root_rng, round_idx)
-        return self._round_fn(global_variables, server_state, batches, weights, rkey)
+        return self._round_fn(
+            global_variables, server_state, batches, weights, num_steps, rkey
+        )
 
     def evaluate(self, variables) -> dict[str, float]:
         out = {}

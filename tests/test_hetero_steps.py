@@ -1,0 +1,151 @@
+"""Heterogeneous per-client local work inside the jitted round (SURVEY "hard
+parts" mask-based early exit; reference FedNova per-client τ semantics,
+standalone/fednova/fednova.py:79-154, and the FedProx straggler protocol)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.algorithms.fednova import fednova_aggregator, fednova_optimizer
+from fedml_tpu.algorithms.fedprox import straggler_epochs
+from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.sim.cohort import stack_cohort
+from fedml_tpu.sim.engine import FedSim, SimConfig
+
+
+def _client_data(seed=0, n=32, batch=4):
+    train, _ = gaussian_blobs(
+        n_clients=1, samples_per_client=n, num_classes=4, dim=8, seed=seed
+    )
+    stack, w = stack_cohort(train, np.asarray([0]), batch_size=batch)
+    return jax.tree.map(lambda v: jnp.asarray(v[0]), stack), float(w[0])
+
+
+def test_masked_early_exit_equals_shorter_scan():
+    """num_steps = e*S must equal literally running e epochs."""
+    data, _ = _client_data()
+    S = data["x"].shape[0]
+    tr2 = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.1), epochs=2
+    )
+    tr1 = dataclasses.replace(tr2, epochs=1)
+    variables = tr2.init(jax.random.key(0), jax.tree.map(lambda v: v[0], data))
+    rng = jax.random.key(1)
+
+    full2, m2 = make_local_train(tr2)(variables, data, rng)
+    # budget = 1 epoch out of 2: same params as a 1-epoch trainer
+    capped, mc = make_local_train(tr2)(variables, data, rng, num_steps=S)
+    short1, m1 = make_local_train(tr1)(variables, data, rng)
+    for a, b in zip(jax.tree.leaves(capped), jax.tree.leaves(short1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+    assert float(mc["train_loss"]) == pytest.approx(float(m1["train_loss"]), abs=1e-6)
+    # and differs from the full 2-epoch run
+    diffs = [
+        np.abs(np.asarray(a) - np.asarray(b)).max()
+        for a, b in zip(jax.tree.leaves(capped), jax.tree.leaves(full2))
+    ]
+    assert max(diffs) > 1e-6
+
+
+def test_zero_budget_is_noop():
+    data, _ = _client_data()
+    tr = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.1), epochs=2
+    )
+    variables = tr.init(jax.random.key(0), jax.tree.map(lambda v: v[0], data))
+    out, _ = make_local_train(tr)(variables, data, jax.random.key(1), num_steps=0)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(variables)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_straggler_epochs_distribution():
+    e = straggler_epochs(round_idx=3, cohort_size=200, epochs=4, straggler_frac=0.5, seed=1)
+    assert e.shape == (200,)
+    assert e.min() >= 1 and e.max() == 4
+    frac = np.mean(e < 4)
+    assert 0.25 < frac < 0.65  # ~half stragglers (some draw e=E-1..1)
+    # deterministic per (round, seed)
+    np.testing.assert_array_equal(
+        e, straggler_epochs(3, 200, 4, 0.5, seed=1)
+    )
+
+
+def test_fednova_tau_eff_reflects_true_heterogeneous_tau():
+    """τ_eff from extras must track the stragglers' true step counts, not the
+    homogeneous sample-count derivation."""
+    tr = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=fednova_optimizer(0.05, momentum=0.9),
+        epochs=4,
+    )
+    train, test = gaussian_blobs(
+        n_clients=4, samples_per_client=32, num_classes=4, dim=8, seed=2
+    )
+    agg = fednova_aggregator(0.05, momentum=0.9, batch_size=8, epochs=4)
+    cfg = SimConfig(
+        client_num_in_total=4, client_num_per_round=4, batch_size=8,
+        comm_round=1, epochs=4, straggler_frac=1.0, seed=3,
+        frequency_of_the_test=10,
+    )
+    sim = FedSim(tr, train, test, cfg, aggregator=agg)
+    _, hist = sim.run()
+    tau_eff_straggler = hist[-1]["tau_eff"]
+
+    cfg_full = dataclasses.replace(cfg, straggler_frac=0.0)
+    _, hist_full = FedSim(tr, train, test, cfg_full, aggregator=agg).run()
+    tau_eff_full = hist_full[-1]["tau_eff"]
+
+    # full budget: every client runs 4 epochs x 4 steps = 16 true steps;
+    # momentum normalizer a_i < tau but equal across clients
+    e = straggler_epochs(0, 4, 4, 1.0, seed=3)
+    assert e.min() < 4  # seed produces real stragglers
+    assert tau_eff_straggler < tau_eff_full
+    # τ_eff (mu=0) = Σ p_i a(τ_i) with a the momentum normalizer; verify exactly
+    from fedml_tpu.algorithms.fednova import normalizing_vector
+
+    tau_true = jnp.asarray(e * 4, jnp.float32)
+    a = normalizing_vector(tau_true, 0.9, 0.0, 16)
+    want = float(jnp.mean(a))  # equal weights
+    assert tau_eff_straggler == pytest.approx(want, rel=1e-5)
+
+
+def test_fedsim_straggler_round_runs_and_learns():
+    tr = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=2
+    )
+    train, test = gaussian_blobs(
+        n_clients=8, samples_per_client=40, num_classes=4, dim=8, seed=4
+    )
+    cfg = SimConfig(
+        client_num_in_total=8, client_num_per_round=8, batch_size=8,
+        comm_round=6, epochs=2, straggler_frac=0.5, seed=5,
+        frequency_of_the_test=6,
+    )
+    _, hist = FedSim(tr, train, test, cfg).run()
+    assert np.isfinite(hist[-1]["Train/Loss"])
+    assert hist[-1]["Train/Acc"] > 0.6
+
+
+def test_fednova_extras_tau_respects_loop_bound():
+    """A misconfigured aggregator (stale epochs/batch) must not silently
+    truncate the normalizer against an un-truncated tau: both are clamped to
+    the same bound, keeping coeff = tau_eff*p/a consistent."""
+    g = {"params": {"w": jnp.ones((4,))}}
+    stacked = {"params": {"w": jnp.zeros((2, 4))}}
+    weights = jnp.asarray([1.0, 1.0])
+    agg = fednova_aggregator(0.1, momentum=0.0, batch_size=8, epochs=1)
+    # plain SGD: a == tau, so coeff = tau_eff*p/tau and the update equals the
+    # weighted mean of deltas regardless of the (clamped) tau magnitude
+    out, _, m = agg.aggregate(
+        g, stacked, weights, (), jax.random.key(0),
+        {"tau": jnp.asarray([50.0, 50.0]), "max_tau": 16},
+    )
+    assert np.isfinite(float(m["tau_eff"]))
+    assert float(m["tau_eff"]) == pytest.approx(16.0)  # clamped to bound
+    np.testing.assert_allclose(np.asarray(out["params"]["w"]), 0.0, atol=1e-6)
