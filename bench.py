@@ -63,7 +63,7 @@ def bench_jax() -> float:
 
     from fedml_tpu.core import rng as rnglib
 
-    variables = jax.device_put(sim.init_variables(), sim._rep)
+    variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     root = rnglib.root_key(0)
 

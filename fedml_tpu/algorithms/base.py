@@ -31,12 +31,32 @@ class Aggregator:
     (per-client sample counts — the reference's weighting scheme).
     ``extras`` is an optional dict of additional per-client arrays the engine
     supplies — currently ``tau`` [C], the true local SGD step counts
-    (heterogeneous under the straggler protocol), consumed by FedNova.
+    (heterogeneous under the straggler protocol), consumed by FedNova, and
+    the static ``max_tau`` loop bound.
+
+    ``per_client=True`` switches the engine to per-client persistent models
+    (decentralized/gossip FL): the first aggregate argument and return value
+    are then *stacked* [C, ...] pytrees — each client trains from its own
+    round-(r-1) model, and aggregation maps the trained stack to next round's
+    per-client stack (e.g. a mixing-matrix multiply). The reference analogue
+    is each DecentralizedWorker holding its own model across rounds
+    (decentralized_framework/decentralized_worker.py:4).
     """
 
     init_state: Callable[[Pytree], Any]
     aggregate: Callable[..., tuple[Pytree, Any, dict]]
     name: str = "aggregator"
+    per_client: bool = False
+    # per_client only: number of real clients the rule is configured for
+    # (e.g. the mixing matrix's order) — the engine validates it against
+    # client_num_in_total so a misconfigured topology fails loudly instead of
+    # silently isolating the overflow clients behind identity rows
+    num_clients: int | None = None
+    # per_client only: gather the previous round's full model stack as the
+    # first aggregate argument (costs an all_gather; rules like gossip that
+    # only consume the trained stack leave this off and receive the local
+    # shard's slice instead)
+    needs_prev_stack: bool = False
 
 
 def fedavg_aggregator() -> Aggregator:

@@ -33,27 +33,59 @@ def mix(stacked: Pytree, mixing_matrix: jnp.ndarray) -> Pytree:
     def _mix(leaf):
         flat = leaf.reshape(leaf.shape[0], -1).astype(jnp.float32)
         out = mixing_matrix @ flat
-        return out.reshape(leaf.shape).astype(leaf.dtype)
+        # mixing_matrix may carry only a block of rows [R, C] (sharded mix)
+        return out.reshape(out.shape[:1] + leaf.shape[1:]).astype(leaf.dtype)
 
     return jax.tree.map(_mix, stacked)
 
 
 def gossip_aggregator(mixing_matrix: np.ndarray) -> Aggregator:
-    """Decentralized 'aggregation': no global model — each client's new model
-    is its neighborhood mixture. The returned global is the uniform average
-    (for eval/checkpointing); per-client models live in the aggregator state.
+    """Decentralized 'aggregation': no global model — each client's next-round
+    model is its neighborhood mixture of this round's locally-trained models.
+
+    ``per_client=True``: the engine keeps the full stacked [C, ...] model set
+    across rounds (each client trains from its OWN model — the property that
+    distinguishes gossip from FedAvg), and this aggregate maps trained stack
+    -> mixed stack. Zero-weight mesh-padding slots pass through untouched
+    (identity mixing rows appended on the fly; the engine validates that real
+    clients == the matrix order via ``num_clients``).
+
+    Sharding: when the engine provides shard extras, only this shard's block
+    of mixing rows is computed — W[local] @ stacked — instead of every device
+    redundantly producing the full C×C mix.
     """
-    W = jnp.asarray(mixing_matrix)
+    W0 = np.asarray(mixing_matrix, np.float32)
 
-    def init_state(global_variables):
-        return None  # stacked per-client models, created on first round
+    def init_state(stacked_variables):
+        return ()
 
-    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
-        mixed = mix(stacked, W)
-        mean = jax.tree.map(lambda s: jnp.mean(s, axis=0), mixed)
-        return mean, mixed, {}
+    def aggregate(prev_stacked, stacked, weights, state, rng, extras=None):
+        C = jax.tree.leaves(stacked)[0].shape[0]
+        if C > W0.shape[0]:  # mesh padding: dummy slots mix only with themselves
+            W = np.eye(C, dtype=np.float32)
+            W[: W0.shape[0], : W0.shape[1]] = W0
+        else:
+            W = W0
+        # consensus disagreement of the trained models (pre-mix, computed on
+        # the fully-gathered stack so the metric is shard-replicated): the
+        # quantity one gossip exchange then contracts
+        def _disagree(leaf):
+            f = leaf.reshape(C, -1).astype(jnp.float32)[: W0.shape[0]]
+            return jnp.sum((f - jnp.mean(f, axis=0, keepdims=True)) ** 2)
 
-    return Aggregator(init_state, aggregate, name="gossip")
+        dis = sum(jax.tree.leaves(jax.tree.map(_disagree, stacked)))
+        metrics = {"consensus_dist": dis / W0.shape[0]}
+        if extras is not None and "shard_start" in extras:
+            W_rows = jax.lax.dynamic_slice_in_dim(
+                jnp.asarray(W), extras["shard_start"], extras["shard_size"], 0
+            )
+            return mix(stacked, W_rows), state, metrics
+        return mix(stacked, jnp.asarray(W)), state, metrics
+
+    return Aggregator(
+        init_state, aggregate, name="gossip", per_client=True,
+        num_clients=int(W0.shape[0]),
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -47,6 +47,11 @@ class HierarchicalFedAvg:
     """Two-level loop reusing the vectorized round program per group."""
 
     def __init__(self, sim: FedSim, hier: HierConfig):
+        if sim._per_client:
+            raise ValueError(
+                "HierarchicalFedAvg drives the broadcast-global round program; "
+                "per-client aggregators (decentralized/gossip) are not composable here"
+            )
         self.sim = sim
         self.hier = hier
         self.groups = random_group_assignment(

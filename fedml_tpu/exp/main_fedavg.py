@@ -53,6 +53,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--server_lr", type=float, default=1e-1)
     parser.add_argument("--server_momentum", type=float, default=0.9)
     parser.add_argument("--fedprox_mu", type=float, default=0.1)
+    parser.add_argument("--straggler_frac", type=float, default=0.0,
+                        help="fraction of each cohort running a reduced "
+                             "uniform 1..E-1 local-epoch budget (FedProx "
+                             "straggler protocol)")
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
     # robustness knobs (fedavg_robust main_fedavg_robust.py args)
@@ -114,7 +118,18 @@ def build_aggregator(args, train_data):
         return robust_aggregator(RobustConfig(
             norm_bound=args.norm_bound, stddev=args.stddev, rule=args.robust_rule,
         ))
-    return fedavg_aggregator()
+    if args.algorithm == "decentralized":
+        from fedml_tpu.algorithms.decentralized import gossip_aggregator
+        from fedml_tpu.topology.topology import ring_topology
+
+        return gossip_aggregator(ring_topology(train_data.num_clients))
+    if args.algorithm in ("fedavg", "fedprox", "hierarchical"):
+        return fedavg_aggregator()
+    # an accepted-but-unwired choice must fail loudly, never silently run
+    # a different algorithm (round-1 defect: fedgan fell through to fedavg)
+    raise NotImplementedError(
+        f"--algorithm {args.algorithm} has no engine wiring yet"
+    )
 
 
 def run(args) -> list[dict]:
@@ -136,14 +151,21 @@ def run(args) -> list[dict]:
     trainer = build_trainer(args, model, args.dataset)
     aggregator = build_aggregator(args, ds.train)
 
+    # decentralized/gossip: every node participates every round
+    per_round = (
+        ds.train.num_clients
+        if args.algorithm == "decentralized"
+        else min(args.client_num_per_round, ds.train.num_clients)
+    )
     cfg = SimConfig(
         client_num_in_total=ds.train.num_clients,
-        client_num_per_round=min(args.client_num_per_round, ds.train.num_clients),
+        client_num_per_round=per_round,
         batch_size=args.batch_size,
         comm_round=args.comm_round,
         epochs=args.epochs,
         frequency_of_the_test=args.frequency_of_the_test if not args.ci else args.comm_round,
         seed=args.seed,
+        straggler_frac=args.straggler_frac,
     )
 
     metrics = MetricsLogger(run_dir=args.run_dir, use_wandb=bool(args.enable_wandb))
@@ -174,7 +196,7 @@ def run(args) -> list[dict]:
     # checkpoint/resume-aware run loop
     from fedml_tpu.core import rng as rnglib
 
-    variables = jax.device_put(sim.init_variables(), sim._rep)
+    variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     start_round = 0
     history: list[dict] = []
@@ -189,7 +211,7 @@ def run(args) -> list[dict]:
         jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
         rec = {"round": r, **{k: float(v) for k, v in m.items()}}
         if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-            rec.update(sim.evaluate(variables))
+            rec.update(sim.evaluate(sim.consensus(variables)))
         history.append(rec)
         metrics.log(rec, round_idx=r)
         if ckptr is not None and args.checkpoint_every and (r + 1) % args.checkpoint_every == 0:

@@ -78,6 +78,23 @@ class FedSim:
         self.config = config
         self.aggregator = aggregator or fedavg_aggregator()
         self.mesh = mesh if mesh is not None else meshlib.client_mesh()
+        # per-client persistent models (decentralized/gossip FL): each client
+        # trains from its own round-(r-1) model instead of a broadcast global
+        self._per_client = bool(getattr(self.aggregator, "per_client", False))
+        if self._per_client and config.client_num_per_round != config.client_num_in_total:
+            raise ValueError(
+                "per-client aggregators (decentralized/gossip) require full "
+                "participation: client_num_per_round == client_num_in_total "
+                f"(got {config.client_num_per_round} != {config.client_num_in_total})"
+            )
+        agg_n = getattr(self.aggregator, "num_clients", None)
+        if self._per_client and agg_n is not None and agg_n != config.client_num_in_total:
+            raise ValueError(
+                f"aggregator '{self.aggregator.name}' is configured for "
+                f"{agg_n} clients (e.g. its mixing-matrix order) but "
+                f"client_num_in_total={config.client_num_in_total} — a "
+                "mismatched topology would silently isolate clients"
+            )
 
         self._local_train = make_local_train(trainer)
         self._local_eval = make_local_eval(trainer)
@@ -101,12 +118,15 @@ class FedSim:
         from jax.sharding import PartitionSpec as P
 
         cohort_spec = P(meshlib.CLIENT_AXIS)
+        # per-client mode: the model state is itself a stacked [C, ...] pytree
+        # sharded over the clients axis, in and out of the round program
+        var_spec = cohort_spec if self._per_client else P()
         self._round_fn = jax.jit(
             jax.shard_map(
                 self._round_impl,
                 mesh=self.mesh,
-                in_specs=(P(), P(), cohort_spec, cohort_spec, cohort_spec, P()),
-                out_specs=(P(), P(), P()),
+                in_specs=(var_spec, P(), cohort_spec, cohort_spec, cohort_spec, P()),
+                out_specs=(var_spec, P(), P()),
                 axis_names=frozenset({meshlib.CLIENT_AXIS}),
                 check_vma=False,
             ),
@@ -137,8 +157,11 @@ class FedSim:
         shard_idx = jax.lax.axis_index(CLIENT_AXIS)
         slot_ids = shard_idx * c_local + jnp.arange(c_local)
         keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(slot_ids)
+        # per-client mode: each client starts from its own model (stacked
+        # leading axis); broadcast mode: everyone starts from the global
+        var_axis = 0 if self._per_client else None
         local_vars, train_metrics = jax.vmap(
-            self._local_train, in_axes=(None, 0, 0, 0)
+            self._local_train, in_axes=(var_axis, 0, 0, 0)
         )(global_variables, batches, keys, num_steps)
         # Full cohort stack for the aggregator (robust rules need every
         # client's model: median/krum/clipping are cross-client).
@@ -155,9 +178,33 @@ class FedSim:
             jnp.maximum(all_weights, 1.0) / self.config.batch_size
         )
         extras = {"tau": tau, "max_tau": self.trainer.epochs * self._steps}
-        new_global, server_state, agg_metrics = self.aggregator.aggregate(
-            global_variables, stacked, all_weights, server_state, rng, extras
-        )
+        if self._per_client:
+            # shard info lets the rule compute only its block of output rows
+            extras["shard_start"] = shard_idx * c_local
+            extras["shard_size"] = c_local
+            prev = (
+                jax.tree.map(gather, global_variables)
+                if getattr(self.aggregator, "needs_prev_stack", False)
+                else global_variables  # this shard's slice, un-gathered
+            )
+            new_stacked, server_state, agg_metrics = self.aggregator.aggregate(
+                prev, stacked, all_weights, server_state, rng, extras
+            )
+            # rules may return the local block directly or the full stack
+            out_c = jax.tree.leaves(new_stacked)[0].shape[0]
+            if out_c == c_local:
+                new_global = new_stacked
+            else:
+                new_global = jax.tree.map(
+                    lambda l: jax.lax.dynamic_slice_in_dim(
+                        l, shard_idx * c_local, c_local, 0
+                    ),
+                    new_stacked,
+                )
+        else:
+            new_global, server_state, agg_metrics = self.aggregator.aggregate(
+                global_variables, stacked, all_weights, server_state, rng, extras
+            )
         metrics = {
             "Train/Loss": jnp.sum(
                 all_losses * all_weights / jnp.sum(all_weights)
@@ -187,6 +234,29 @@ class FedSim:
         }
         sample.setdefault("mask", jnp.ones((self.config.batch_size,), jnp.float32))
         return self.trainer.init(jax.random.key(self.config.seed), sample)
+
+    def init_round_variables(self) -> Pytree:
+        """Model state in the engine's layout: a replicated global model, or —
+        per-client mode — an identical-init stacked [C_pad, ...] model set
+        sharded over the clients axis (every node starts from the same point,
+        the standard decentralized-optimization setup)."""
+        v = self.init_variables()
+        if not self._per_client:
+            return jax.device_put(v, self._rep)
+        n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
+        c_pad = -(-self.config.client_num_in_total // n_dev) * n_dev
+        stacked = jax.tree.map(
+            lambda l: jnp.broadcast_to(l[None], (c_pad,) + l.shape), v
+        )
+        return jax.device_put(stacked, meshlib.client_sharded(self.mesh))
+
+    def consensus(self, variables: Pytree) -> Pytree:
+        """A single evaluable model: identity in broadcast mode; the node
+        average over real clients (padding excluded) in per-client mode."""
+        if not self._per_client:
+            return variables
+        n = self.config.client_num_in_total
+        return jax.tree.map(lambda l: jnp.mean(l[:n], axis=0), variables)
 
     def stage_cohort(self, cohort, round_idx: int):
         """Stage an explicit cohort's data on device: stack, apply straggler
@@ -237,9 +307,14 @@ class FedSim:
     def stage_round(self, round_idx: int):
         """Sample the round's cohort and stage its data on device."""
         cfg = self.config
-        cohort = rnglib.sample_clients(
-            round_idx, cfg.client_num_in_total, cfg.client_num_per_round
-        )
+        if self._per_client:
+            # stable identity order: slot i is client i every round, so the
+            # persistent stack and the mixing matrix's adjacency line up
+            cohort = np.arange(cfg.client_num_in_total)
+        else:
+            cohort = rnglib.sample_clients(
+                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            )
         return (cohort, *self.stage_cohort(cohort, round_idx))
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
@@ -262,7 +337,7 @@ class FedSim:
 
     def run(self, callback=None) -> tuple[Pytree, list[dict]]:
         cfg = self.config
-        variables = jax.device_put(self.init_variables(), self._rep)
+        variables = self.init_round_variables()
         server_state = self.aggregator.init_state(variables)
         root = rnglib.root_key(cfg.seed)
         history = []
@@ -275,7 +350,7 @@ class FedSim:
             rec = {"round": r, "round_time": time.perf_counter() - t0}
             rec.update({k: float(v) for k, v in metrics.items()})
             if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-                rec.update(self.evaluate(variables))
+                rec.update(self.evaluate(self.consensus(variables)))
             history.append(rec)
             if callback:
                 callback(rec)
