@@ -189,20 +189,22 @@ class FedAvgClientManager(ClientManager):
         self.send_message(out)
 
 
-def run_distributed_fedavg_loopback(
+def run_distributed_fedavg(
     trainer: ClientTrainer,
     train_data: FederatedArrays,
     worker_num: int,
     round_num: int,
     batch_size: int,
+    make_comm: Callable[[int], BaseCommunicationManager],
     seed: int = 0,
+    on_round_done: Callable[[int, Any], None] | None = None,
 ):
-    """End-to-end distributed FedAvg on the in-process loopback fabric —
-    the test harness the reference lacked (SURVEY §4). Returns the final
-    global variables."""
-    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
-
-    fabric = LoopbackFabric(worker_num + 1)
+    """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
+    builds rank 0's server transport and ranks 1..W's client transports
+    (loopback queues, native shm rings, grpc localhost, ...). Clients run in
+    threads — the single-host harness the reference lacked (SURVEY §4); the
+    same managers drive separate processes when the transport spans them.
+    Returns the final global variables."""
     sample = {
         name: jnp.asarray(arr[:batch_size]) for name, arr in train_data.arrays.items()
     }
@@ -212,14 +214,20 @@ def run_distributed_fedavg_loopback(
     flat, desc = pack_pytree(template)
 
     results: dict[str, np.ndarray] = {}
+
+    def _done(r, f):
+        results["final"] = f
+        if on_round_done is not None:
+            on_round_done(r, unpack_pytree(f, desc))
+
     server = FedAvgServerManager(
-        LoopbackCommManager(fabric, 0), worker_num, round_num, flat, desc,
+        make_comm(0), worker_num, round_num, flat, desc,
         client_num_in_total=train_data.num_clients,
-        on_round_done=lambda r, f: results.__setitem__("final", f),
+        on_round_done=_done,
     )
     clients = [
         FedAvgClientManager(
-            LoopbackCommManager(fabric, r), r, worker_num + 1, trainer,
+            make_comm(r), r, worker_num + 1, trainer,
             train_data, batch_size, template,
         )
         for r in range(1, worker_num + 1)
@@ -234,3 +242,81 @@ def run_distributed_fedavg_loopback(
     for t in threads:
         t.join(timeout=30)
     return unpack_pytree(results["final"], desc)
+
+
+def run_distributed_fedavg_loopback(
+    trainer: ClientTrainer,
+    train_data: FederatedArrays,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+    on_round_done: Callable[[int, Any], None] | None = None,
+):
+    """Distributed FedAvg on the in-process loopback fabric."""
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(worker_num + 1)
+    return run_distributed_fedavg(
+        trainer, train_data, worker_num, round_num, batch_size,
+        lambda r: LoopbackCommManager(fabric, r), seed, on_round_done,
+    )
+
+
+def run_distributed_fedavg_shm(
+    trainer: ClientTrainer,
+    train_data: FederatedArrays,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+    job: str | None = None,
+    on_round_done: Callable[[int, Any], None] | None = None,
+):
+    """Distributed FedAvg over the native shared-memory rings (the MPI-role
+    single-host transport, comm/shm.py + ops/native/shm_ring.cpp)."""
+    import uuid
+
+    from fedml_tpu.comm.shm import ShmCommManager
+
+    job = job or f"fedavg_{uuid.uuid4().hex[:8]}"
+    mgrs = {
+        r: ShmCommManager(job, r, worker_num + 1) for r in range(worker_num + 1)
+    }
+    try:
+        return run_distributed_fedavg(
+            trainer, train_data, worker_num, round_num, batch_size,
+            lambda r: mgrs[r], seed, on_round_done,
+        )
+    finally:
+        for m in mgrs.values():
+            m.cleanup()
+
+
+def run_distributed_fedavg_grpc(
+    trainer: ClientTrainer,
+    train_data: FederatedArrays,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+    base_port: int = 29500,
+    on_round_done: Callable[[int, Any], None] | None = None,
+):
+    """Distributed FedAvg over localhost gRPC (cross-host transport run
+    single-host; an ip_config table generalizes it to a cluster, reference
+    grpc_ipconfig.csv)."""
+    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+
+    ip_config = {
+        r: ("127.0.0.1", base_port + r) for r in range(worker_num + 1)
+    }
+    mgrs = {r: GrpcCommManager(r, ip_config) for r in range(worker_num + 1)}
+    try:
+        return run_distributed_fedavg(
+            trainer, train_data, worker_num, round_num, batch_size,
+            lambda r: mgrs[r], seed, on_round_done,
+        )
+    finally:
+        for m in mgrs.values():
+            m.stop_receive_message()

@@ -90,29 +90,46 @@ class GANTrainer:
 
 
 def make_gan_local_train(trainer: GANTrainer):
-    """local_train(global_pair, data, rng) -> (pair, metrics) — same contract
-    as core.trainer.make_local_train, so FedSim can federate GANs unchanged."""
+    """local_train(global_pair, data, rng, num_steps=None) -> (pair, metrics)
+    — same contract as core.trainer.make_local_train (incl. the per-client
+    step budget), so FedSim can federate GANs unchanged."""
 
-    def local_train(global_variables: Pytree, data: dict, rng: jax.Array):
+    def local_train(global_variables: Pytree, data: dict, rng: jax.Array,
+                    num_steps=None):
         opt_states = (
             trainer.g_opt.init(global_variables["generator"]["params"]),
             trainer.d_opt.init(global_variables["discriminator"]["params"]),
         )
+        S = jax.tree.leaves(data)[0].shape[0]
 
-        def epoch(carry, _):
+        def epoch(carry, e):
             variables, opt_states, rng = carry
 
-            def step(carry, batch):
+            def step(carry, xs):
                 variables, opt_states, rng = carry
+                s, batch = xs
                 rng, sub = jax.random.split(rng)
-                variables, opt_states, losses = trainer.train_step(variables, opt_states, batch, sub)
+                new_vars, new_opts, losses = trainer.train_step(
+                    variables, opt_states, batch, sub
+                )
+                # freeze past the step budget or on fully-padded batches
+                active = jnp.sum(batch["mask"]) > 0
+                if num_steps is not None:
+                    active = active & ((e * S + s) < num_steps)
+                keep = lambda n, o: jax.tree.map(
+                    lambda a, b: jnp.where(active, a, b), n, o
+                )
+                variables = keep(new_vars, variables)
+                opt_states = keep(new_opts, opt_states)
                 return (variables, opt_states, rng), losses["g_loss"] + losses["d_loss"]
 
-            (variables, opt_states, rng), losses = jax.lax.scan(step, (variables, opt_states, rng), data)
+            (variables, opt_states, rng), losses = jax.lax.scan(
+                step, (variables, opt_states, rng), (jnp.arange(S), data)
+            )
             return (variables, opt_states, rng), losses.mean()
 
         (variables, opt_states, rng), epoch_losses = jax.lax.scan(
-            epoch, (global_variables, opt_states, rng), None, length=trainer.epochs
+            epoch, (global_variables, opt_states, rng), jnp.arange(trainer.epochs)
         )
         return variables, {"train_loss": epoch_losses[-1]}
 

@@ -62,6 +62,11 @@ class FedSim:
     test_arrays: dict of [N, ...] arrays — pooled global test set
     aggregator: server aggregation rule; defaults to FedAvg weighted mean
     mesh: jax Mesh with a "clients" axis; defaults to all local devices
+    local_train_fn: override for the client-side round program — any
+        ``(variables, data, rng, num_steps) -> (variables, metrics)``
+        (e.g. make_gan_local_train's adversarial loop); defaults to
+        make_local_train(trainer). Trainers without ``eval_batch`` (GAN)
+        simply skip server-side evaluation.
     """
 
     def __init__(
@@ -72,6 +77,7 @@ class FedSim:
         config: SimConfig,
         aggregator: Aggregator | None = None,
         mesh=None,
+        local_train_fn=None,
     ):
         self.trainer = trainer
         self.train_data = train_data
@@ -96,8 +102,9 @@ class FedSim:
                 "mismatched topology would silently isolate clients"
             )
 
-        self._local_train = make_local_train(trainer)
-        self._local_eval = make_local_eval(trainer)
+        self._local_train = local_train_fn or make_local_train(trainer)
+        self._can_eval = hasattr(trainer, "eval_batch")
+        self._local_eval = make_local_eval(trainer) if self._can_eval else None
 
         # Pin steps-per-epoch to the global max so every round compiles once.
         self._steps = cohortlib.steps_per_epoch(
@@ -132,15 +139,17 @@ class FedSim:
             ),
             donate_argnums=(0,),
         )
-        self._eval_fn = jax.jit(self._eval_impl)
+        self._eval_fn = jax.jit(self._eval_impl) if self._can_eval else None
 
         self._test_batches = (
             cohortlib.batch_array(test_arrays, config.eval_batch_size)
-            if test_arrays is not None
+            if test_arrays is not None and self._can_eval
             else None
         )
-        self._train_eval_batches = cohortlib.batch_array(
-            train_data.arrays, config.eval_batch_size
+        self._train_eval_batches = (
+            cohortlib.batch_array(train_data.arrays, config.eval_batch_size)
+            if self._can_eval
+            else None
         )
 
     # -- jitted programs -----------------------------------------------------
@@ -325,6 +334,8 @@ class FedSim:
         )
 
     def evaluate(self, variables) -> dict[str, float]:
+        if not self._can_eval:
+            return {}
         out = {}
         train_m = self._eval_fn(variables, self._train_eval_batches)
         out["Train/Acc"] = float(train_m["Acc"])

@@ -140,11 +140,14 @@ def test_cli_decentralized_smoke(tmp_path):
     assert "consensus_dist" in final
 
 
-def test_cli_unwired_algorithm_errors():
+def test_cli_unsupported_combination_errors():
+    """Every accepted flag combination either runs the named thing or exits
+    loudly — message-passing backends only speak the FedAvg protocol."""
     from fedml_tpu.exp.main_fedavg import main
 
-    with pytest.raises(NotImplementedError, match="fedgan"):
+    with pytest.raises(NotImplementedError, match="sim-engine only"):
         main([
-            "--dataset", "synthetic", "--model", "lr", "--algorithm", "fedgan",
+            "--dataset", "synthetic", "--model", "lr", "--algorithm", "fedopt",
+            "--backend", "loopback",
             "--client_num_in_total", "4", "--comm_round", "1",
         ])
