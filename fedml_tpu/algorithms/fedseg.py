@@ -24,8 +24,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.core.trainer import ClientTrainer, make_local_eval
-from fedml_tpu.sim import cohort as cohortlib
+from fedml_tpu.core.trainer import ClientTrainer
 from fedml_tpu.sim.engine import FedSim
 
 
@@ -108,9 +107,6 @@ class FedSegSim(FedSim):
         assert trainer.task == "segmentation", "FedSegSim requires the segmentation task"
         super().__init__(trainer, train_data, test_arrays, config,
                          aggregator=aggregator, mesh=mesh)
-        self._client_eval = jax.jit(
-            jax.vmap(make_local_eval(self.trainer), in_axes=(None, 0))
-        )
 
     def evaluate_clients(self, variables, client_ids=None, batch_size=None):
         """Returns (per-client EvaluationMetricsKeeper dict, global metrics dict)."""
@@ -120,10 +116,9 @@ class FedSegSim(FedSim):
             if client_ids is not None
             else np.arange(cfg.client_num_in_total)
         )
-        stack = cohortlib.stack_client_eval(
-            self.train_data, ids, batch_size or cfg.eval_batch_size
+        m = self.evaluate_per_client(
+            variables, client_ids=ids, batch_size=batch_size or cfg.eval_batch_size
         )
-        m = self._client_eval(variables, jax.tree.map(jnp.asarray, stack))
         confs = np.asarray(m["confusion"])  # [C_clients, num_classes, num_classes]
         losses = np.asarray(m["test_loss"]) / np.maximum(np.asarray(m["test_total"]), 1.0)
         per_client = {

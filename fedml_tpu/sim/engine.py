@@ -50,6 +50,12 @@ class SimConfig:
     # scan — the heterogeneity FedProx/FedNova were designed for, absent from
     # the reference despite the naming, SURVEY §5.3)
     straggler_frac: float = 0.0
+    # Server-side per-client evaluation at test frequency (reference
+    # FedAVGAggregator.test_on_server_for_all_clients, FedAVGAggregator.py:110-164)
+    eval_on_clients: bool = False
+    # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
+    # TPU equivalent of the reference's wandb/host tracing)
+    profile_dir: str | None = None
 
 
 class FedSim:
@@ -105,6 +111,11 @@ class FedSim:
         self._local_train = local_train_fn or make_local_train(trainer)
         self._can_eval = hasattr(trainer, "eval_batch")
         self._local_eval = make_local_eval(trainer) if self._can_eval else None
+        self._client_eval_fn = (
+            jax.jit(jax.vmap(self._local_eval, in_axes=(None, 0)))
+            if self._can_eval
+            else None
+        )
 
         # Pin steps-per-epoch to the global max so every round compiles once.
         self._steps = cohortlib.steps_per_epoch(
@@ -333,6 +344,65 @@ class FedSim:
             global_variables, server_state, batches, weights, num_steps, rkey
         )
 
+    def evaluate_per_client(
+        self,
+        variables,
+        client_ids=None,
+        data: cohortlib.FederatedArrays | None = None,
+        batch_size: int | None = None,
+        chunk: int = 64,
+    ) -> dict[str, np.ndarray]:
+        """Vectorized server-side eval of one model on every client's shard.
+
+        The reference walks clients serially through one torch loop
+        (FedAVGAggregator.test_on_server_for_all_clients,
+        FedAVGAggregator.py:110-164); here a single jitted
+        ``vmap(local_eval)`` evaluates a whole chunk of clients at once.
+        Returns raw summed metric arrays keyed like ``trainer.eval_batch``'s
+        output (e.g. test_correct/test_total/test_loss, plus task extras such
+        as fedseg's per-client confusion matrices), each with a leading
+        [num_clients] axis. Clients are processed in uniform-shape chunks of
+        ``min(chunk, len(ids))``, so repeated calls over the same client set
+        reuse one compiled program.
+        """
+        if not self._can_eval:
+            return {}
+        data = data if data is not None else self.train_data
+        ids = np.asarray(
+            client_ids if client_ids is not None else np.arange(data.num_clients)
+        )
+        if len(ids) == 0:
+            return {}
+        bs = batch_size or self.config.eval_batch_size
+        steps = cohortlib.steps_per_epoch(data.max_client_size(), bs)
+        csz = min(chunk, len(ids))
+        outs = []
+        for lo in range(0, len(ids), csz):
+            sel = ids[lo : lo + csz]
+            pad = csz - len(sel)
+            padded = np.concatenate([sel, np.repeat(sel[-1:], pad)]) if pad else sel
+            stack = cohortlib.stack_client_eval(data, padded, bs, steps=steps)
+            if pad:  # fully mask the duplicate tail clients
+                stack["mask"][len(sel):] = 0.0
+            m = self._client_eval_fn(variables, jax.tree.map(jnp.asarray, stack))
+            outs.append(jax.tree.map(lambda x: np.asarray(x)[: len(sel)], m))
+        return {
+            k: np.concatenate([o[k] for o in outs]) for k in outs[0]
+        }
+
+    def per_client_summary(self, variables) -> dict[str, float]:
+        """Pooled train metrics from the per-client eval — the numbers the
+        reference logs from test_on_server_for_all_clients (sum of per-client
+        corrects / totals, FedAVGAggregator.py:139-147)."""
+        m = self.evaluate_per_client(variables)
+        if not m or "test_total" not in m:
+            return {}
+        total = max(float(m["test_total"].sum()), 1.0)
+        return {
+            "Train/AccOnClients": float(m["test_correct"].sum()) / total,
+            "Train/LossOnClients": float(m["test_loss"].sum()) / total,
+        }
+
     def evaluate(self, variables) -> dict[str, float]:
         if not self._can_eval:
             return {}
@@ -352,20 +422,33 @@ class FedSim:
         server_state = self.aggregator.init_state(variables)
         root = rnglib.root_key(cfg.seed)
         history = []
-        for r in range(cfg.comm_round):
-            t0 = time.perf_counter()
-            variables, server_state, metrics = self.run_round(
-                r, variables, server_state, root
-            )
-            jax.block_until_ready(variables)
-            rec = {"round": r, "round_time": time.perf_counter() - t0}
-            rec.update({k: float(v) for k, v in metrics.items()})
-            if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-                rec.update(self.evaluate(self.consensus(variables)))
-            history.append(rec)
-            if callback:
-                callback(rec)
-            logging.info("round %d: %s", r, {k: v for k, v in rec.items() if k != "round"})
+        profiling = False
+        try:
+            for r in range(cfg.comm_round):
+                # start the trace at round 1 so compilation (round 0) doesn't
+                # drown the steady-state rounds in the profile
+                if cfg.profile_dir and not profiling and r == min(1, cfg.comm_round - 1):
+                    jax.profiler.start_trace(cfg.profile_dir)
+                    profiling = True
+                t0 = time.perf_counter()
+                variables, server_state, metrics = self.run_round(
+                    r, variables, server_state, root
+                )
+                jax.block_until_ready(variables)
+                rec = {"round": r, "round_time": time.perf_counter() - t0}
+                rec.update({k: float(v) for k, v in metrics.items()})
+                if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+                    eval_vars = self.consensus(variables)
+                    rec.update(self.evaluate(eval_vars))
+                    if cfg.eval_on_clients:
+                        rec.update(self.per_client_summary(eval_vars))
+                history.append(rec)
+                if callback:
+                    callback(rec)
+                logging.info("round %d: %s", r, {k: v for k, v in rec.items() if k != "round"})
+        finally:
+            if profiling:
+                jax.profiler.stop_trace()
         return variables, history
 
 
