@@ -1,17 +1,25 @@
-"""Benchmark: FedAvg round throughput, flagship config (ResNet-56, CIFAR-10
-shapes) on the local accelerator.
+"""Benchmark: federated round throughput + delivered FLOPs on the local chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": ..., ...}
 
-value = FedAvg rounds/sec (steady state) for 10 clients/round x 1 local epoch
-x 8 steps x batch 32 on ResNet-56 — the reference's cross-silo headline model
-(BASELINE.md cross-silo table) at bench-scale shapes.
+Primary metric (comparable across rounds): FedAvg rounds/sec for the
+reference's cross-silo headline model (ResNet-56, CIFAR-10 shapes;
+BASELINE.md cross-silo table) — 10 clients x 1 local epoch x 8 steps x
+batch 32. ``vs_baseline`` divides it by the same federated round executed
+the reference's way (sequential per-client torch training, this host's CPU —
+the only executable reference here; the reference repo publishes no
+wall-clock, SURVEY §6). The torch number is measured once and cached.
 
-vs_baseline = our rounds/sec divided by the same federated round executed by
-the reference implementation stack (PyTorch, this host's CPU — the only
-executable reference here; the reference repo publishes no wall-clock,
-SURVEY §6). The torch number is measured once and cached in .bench_cache.json.
+MFU story (the number that actually says "fast on TPU"): a big-shape
+federated LM round — TransformerLM (D=1024, L=8, H=16, T=1024, V=32k) in
+bfloat16, 2 clients x 8 local steps x batch 8 — with analytic model FLOPs
+(matmul 2P per token + causal attention at half of 4TD, train = 3x fwd)
+against the chip's peak. Also reports pooled eval throughput on the ResNet.
+
+Timing note: on this tunneled TPU, ``block_until_ready`` does not reliably
+wait for the remote computation, so every measured section forces a host
+fetch of a value that depends on the full program (the round's train loss).
 """
 
 from __future__ import annotations
@@ -28,12 +36,72 @@ STEPS = 8
 BATCH = 32
 EPOCHS = 1
 
+# peak dense bf16 TFLOP/s per chip, by jax device_kind
+PEAK_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,  # v5e
+    "TPU v5": 459.0,       # v5p
+    "TPU v6 lite": 918.0,  # v6e / Trillium
+    "TPU v3": 123.0,
+    "TPU v2": 46.0,
+}
 
-def bench_jax() -> float:
-    """Rounds/sec of the vectorized engine on the default platform."""
+# LM bench shape (tuned to ~30% MFU on a single v5e within its 16G HBM)
+LM_D, LM_L, LM_H, LM_T, LM_V = 1024, 8, 16, 1024, 32000
+LM_CLIENTS, LM_STEPS, LM_BATCH = 2, 8, 8
+
+
+def resnet56_train_flops_per_image() -> float:
+    """Analytic FLOPs (2 x MAC) for one ResNet-56 CIFAR training example:
+    stem + 3 stages x 9 blocks x 2 convs (+1x1 shortcut at stage entry) + fc,
+    with train = 3 x forward (backward ~ 2 x forward)."""
+    fl = 2 * 32 * 32 * 9 * 3 * 16  # stem 3x3, 3->16, 32x32
+    spec = [(16, 16, 32), (16, 32, 16), (32, 64, 8)]
+    for si, (cin, cout, hw) in enumerate(spec):
+        for b in range(9):
+            c_in = cin if b == 0 else cout
+            fl += 2 * hw * hw * 9 * c_in * cout  # conv1 (output spatial size)
+            fl += 2 * hw * hw * 9 * cout * cout  # conv2
+            if b == 0 and si > 0:
+                fl += 2 * hw * hw * 1 * c_in * cout  # 1x1 projection shortcut
+    fl += 2 * 64 * 10  # fc
+    return 3.0 * fl
+
+
+def lm_train_flops_per_round() -> float:
+    """Analytic matmul FLOPs for one federated LM round. Per token forward:
+    2 x (12 L D^2 + D V) for the dense stack + head, plus causal attention
+    counted at half the full 4 T D (only the lower triangle is useful work).
+    Train = 3 x forward; round = clients x steps x batch x T tokens."""
+    p_mm = LM_L * 12 * LM_D * LM_D + LM_D * LM_V
+    fwd_per_tok = 2 * p_mm + LM_L * 2 * LM_T * LM_D
+    tokens = LM_CLIENTS * LM_STEPS * LM_BATCH * LM_T
+    return 3.0 * fwd_per_tok * tokens
+
+
+def _measure_rounds(sim, n_meas: int = 5) -> float:
+    """Seconds per round, steady state. Forces a host fetch of the round's
+    aggregated train loss so remote-async dispatch can't fake the timing."""
+    import jax
+
+    from fedml_tpu.core import rng as rnglib
+
+    variables = sim.init_round_variables()
+    server_state = sim.aggregator.init_state(variables)
+    root = rnglib.root_key(0)
+    variables, server_state, m = sim.run_round(0, variables, server_state, root)
+    float(m["Train/Loss"])  # compile + first-round sync
+    t0 = time.perf_counter()
+    for r in range(1, 1 + n_meas):
+        variables, server_state, m = sim.run_round(r, variables, server_state, root)
+        float(m["Train/Loss"])
+    return (time.perf_counter() - t0) / n_meas
+
+
+def bench_resnet():
+    """(rounds/sec, eval examples/sec) for the primary ResNet-56 config."""
     import numpy as np
 
-    import jax
     import optax
 
     from fedml_tpu.core.trainer import ClientTrainer
@@ -59,29 +127,66 @@ def bench_jax() -> float:
         batch_size=BATCH, comm_round=1, epochs=EPOCHS,
         frequency_of_the_test=10_000, shuffle_each_round=False, seed=0,
     )
-    sim = FedSim(trainer, train, None, cfg)
+    n_eval = 4096
+    test = {
+        "x": rng.rand(n_eval, 32, 32, 3).astype(np.float32),
+        "y": rng.randint(0, 10, n_eval).astype(np.int32),
+    }
+    sim = FedSim(trainer, train, test, cfg)
+    sec_per_round = _measure_rounds(sim)
 
-    from fedml_tpu.core import rng as rnglib
-
+    # pooled eval throughput (examples/sec): evaluate() runs the pooled train
+    # set (n) plus the test set (n_eval) and returns host floats, so it is
+    # synchronous by construction
     variables = sim.init_round_variables()
-    server_state = sim.aggregator.init_state(variables)
-    root = rnglib.root_key(0)
+    sim.evaluate(variables)  # compile
+    n_meas = 3
+    t0 = time.perf_counter()
+    for _ in range(n_meas):
+        sim.evaluate(variables)
+    eval_eps = (n + n_eval) * n_meas / (time.perf_counter() - t0)
+    return 1.0 / sec_per_round, eval_eps
 
-    # warmup (compile)
-    variables, server_state, _ = sim.run_round(0, variables, server_state, root)
-    jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
 
-    times = []
-    for r in range(1, 6):
-        t0 = time.perf_counter()
-        variables, server_state, _ = sim.run_round(r, variables, server_state, root)
-        jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
-        times.append(time.perf_counter() - t0)
-    return 1.0 / (sum(times) / len(times))
+def bench_lm():
+    """Seconds/round for the big-shape bf16 federated LM config."""
+    import numpy as np
+
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.sim.cohort import FederatedArrays
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    rng = np.random.RandomState(0)
+    n_per = LM_STEPS * LM_BATCH
+    n = LM_CLIENTS * n_per
+    x = rng.randint(0, LM_V, (n, LM_T)).astype(np.int32)
+    y = rng.randint(0, LM_V, (n, LM_T)).astype(np.int32)
+    mask = np.ones((n, LM_T), np.float32)
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(LM_CLIENTS)}
+    train = FederatedArrays({"x": x, "y": y, "mask": mask}, part)
+
+    model = TransformerLM(
+        vocab_size=LM_V, embed_dim=LM_D, num_layers=LM_L, num_heads=LM_H,
+        max_len=LM_T, attn_impl="xla", dtype=jnp.bfloat16,
+    )
+    trainer = ClientTrainer(
+        module=model, task="nwp", optimizer=optax.sgd(0.01, momentum=0.9), epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=LM_CLIENTS, client_num_per_round=LM_CLIENTS,
+        batch_size=LM_BATCH, comm_round=1, epochs=1,
+        frequency_of_the_test=10_000, shuffle_each_round=False, seed=0,
+    )
+    sim = FedSim(trainer, train, None, cfg)
+    return _measure_rounds(sim, n_meas=4)
 
 
 def bench_torch_reference() -> float:
-    """Rounds/sec for the same federated round on the reference stack:
+    """Rounds/sec for the primary config on the reference stack:
     sequential per-client torch training (the reference's standalone path,
     fedavg_api.py:56-66) with an equivalent ResNet-56, on CPU."""
     import numpy as np
@@ -158,12 +263,39 @@ def main():
             pass
     baseline = cache[key]
 
-    ours = bench_jax()
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    peak = PEAK_TFLOPS.get(device_kind)
+
+    rounds_per_sec, eval_eps = bench_resnet()
+    resnet_tflops = (
+        resnet56_train_flops_per_image() * CLIENTS * STEPS * BATCH * EPOCHS
+        * rounds_per_sec / 1e12
+    )
+
+    lm_sec = bench_lm()
+    lm_tflops = lm_train_flops_per_round() / lm_sec / 1e12
+    mfu = (lm_tflops / peak) if peak else None
+
     print(json.dumps({
         "metric": "fedavg_rounds_per_sec_resnet56_cifar10_10clients",
-        "value": round(ours, 4),
+        "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
-        "vs_baseline": round(ours / baseline, 2),
+        "vs_baseline": round(rounds_per_sec / baseline, 2),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "extra": {
+            "device": device_kind,
+            "peak_bf16_tflops": peak,
+            "lm_config": (
+                f"TransformerLM bf16 D{LM_D} L{LM_L} H{LM_H} T{LM_T} V{LM_V}, "
+                f"{LM_CLIENTS} clients x {LM_STEPS} steps x batch {LM_BATCH}"
+            ),
+            "lm_sec_per_round": round(lm_sec, 4),
+            "lm_delivered_tflops": round(lm_tflops, 2),
+            "resnet_delivered_tflops": round(resnet_tflops, 2),
+            "eval_examples_per_sec": round(eval_eps, 1),
+        },
     }))
 
 

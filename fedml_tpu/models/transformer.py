@@ -31,12 +31,13 @@ class MultiHeadSelfAttention(nn.Module):
     attn_impl: str = "xla"  # xla | flash | ring
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params stay f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         b, t, c = x.shape
         head_dim = c // self.num_heads
-        qkv = nn.Dense(3 * c, use_bias=False, name="qkv")(x)
+        qkv = nn.Dense(3 * c, use_bias=False, name="qkv", dtype=self.dtype)(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(a):  # [B, T, C] -> [B, H, T, D]
@@ -50,7 +51,7 @@ class MultiHeadSelfAttention(nn.Module):
         else:
             o = attention_reference(q, k, v, causal=True)
         o = o.transpose(0, 2, 1, 3).reshape(b, t, c)
-        o = nn.Dense(c, use_bias=False, name="proj")(o)
+        o = nn.Dense(c, use_bias=False, name="proj", dtype=self.dtype)(o)
         if self.dropout_rate:
             o = nn.Dropout(self.dropout_rate, deterministic=not train)(o)
         return o
@@ -62,18 +63,20 @@ class Block(nn.Module):
     attn_impl: str = "xla"
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.LayerNorm()(x)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadSelfAttention(
-            self.num_heads, self.attn_impl, self.sp_axis, self.dropout_rate
+            self.num_heads, self.attn_impl, self.sp_axis, self.dropout_rate,
+            dtype=self.dtype,
         )(h, train=train)
-        h = nn.LayerNorm()(x)
+        h = nn.LayerNorm(dtype=self.dtype)(x)
         c = x.shape[-1]
-        m = nn.Dense(self.mlp_ratio * c)(h)
+        m = nn.Dense(self.mlp_ratio * c, dtype=self.dtype)(h)
         m = nn.gelu(m)
-        m = nn.Dense(c)(m)
+        m = nn.Dense(c, dtype=self.dtype)(m)
         if self.dropout_rate:
             m = nn.Dropout(self.dropout_rate, deterministic=not train)(m)
         return x + m
@@ -92,25 +95,29 @@ class TransformerLM(nn.Module):
     attn_impl: str = "xla"
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False, pos_offset: int | jnp.ndarray = 0):
         b, t = x.shape
-        tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed")(x)
+        tok = nn.Embed(self.vocab_size, self.embed_dim, name="tok_embed",
+                       dtype=self.dtype)(x)
         pos_table = self.param(
             "pos_embed",
             nn.initializers.normal(0.02),
             (self.max_len, self.embed_dim),
         )
         pos_idx = pos_offset + jnp.arange(t)
-        h = tok + jnp.take(pos_table, pos_idx, axis=0)[None]
+        h = tok + jnp.take(pos_table, pos_idx, axis=0)[None].astype(self.dtype)
         for i in range(self.num_layers):
             h = Block(
                 self.num_heads,
                 attn_impl=self.attn_impl,
                 sp_axis=self.sp_axis,
                 dropout_rate=self.dropout_rate,
+                dtype=self.dtype,
                 name=f"block_{i}",
             )(h, train=train)
-        h = nn.LayerNorm(name="ln_f")(h)
-        return nn.Dense(self.vocab_size, name="head")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        # logits in f32: the loss's softmax needs the headroom
+        return nn.Dense(self.vocab_size, name="head")(h.astype(jnp.float32))

@@ -53,6 +53,12 @@ class SimConfig:
     # Server-side per-client evaluation at test frequency (reference
     # FedAVGAggregator.test_on_server_for_all_clients, FedAVGAggregator.py:110-164)
     eval_on_clients: bool = False
+    # Keep the training arrays resident on device and gather each round's
+    # cohort inside the jitted program — per-round host->device traffic drops
+    # from the full batch stack to a [C, S, B] int32 index array. None = auto
+    # (on when the dataset fits comfortably in HBM). The host-staging path
+    # remains for datasets larger than device memory.
+    stage_on_device: bool | None = None
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
@@ -152,16 +158,61 @@ class FedSim:
         )
         self._eval_fn = jax.jit(self._eval_impl) if self._can_eval else None
 
-        self._test_batches = (
-            cohortlib.batch_array(test_arrays, config.eval_batch_size)
-            if test_arrays is not None and self._can_eval
-            else None
+        # Device-resident dataset + in-program cohort gather: the TPU-first
+        # answer to the reference's per-batch .to(device) traffic — ship the
+        # arrays once, then each round uploads only a [C, S, B] index map.
+        nbytes = sum(a.nbytes for a in train_data.arrays.values())
+        self._on_device = (
+            config.stage_on_device
+            if config.stage_on_device is not None
+            else nbytes <= 2 << 30
         )
-        self._train_eval_batches = (
-            cohortlib.batch_array(train_data.arrays, config.eval_batch_size)
-            if self._can_eval
-            else None
-        )
+        if self._on_device:
+            self._dataset = jax.device_put(
+                {k: jnp.asarray(v) for k, v in train_data.arrays.items()},
+                self._rep,
+            )
+            self._gather_round_fn = jax.jit(
+                jax.shard_map(
+                    self._gather_round_impl,
+                    mesh=self.mesh,
+                    in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
+                              cohort_spec, P()),
+                    out_specs=(var_spec, P(), P()),
+                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+
+        self._test_batches = None
+        if test_arrays is not None and self._can_eval:
+            b = cohortlib.batch_array(test_arrays, config.eval_batch_size)
+            self._test_batches = (
+                jax.device_put(jax.tree.map(jnp.asarray, b), self._rep)
+                if self._on_device
+                else b
+            )
+        # Pooled train eval: on-device mode gathers eval batches from the
+        # already-resident dataset (an index map, not a second copy of the
+        # training arrays in HBM); host mode keeps materialized batches.
+        self._train_eval_batches = None
+        self._train_eval_idx = None
+        if self._can_eval:
+            if self._on_device:
+                n = train_data.num_samples
+                bs = config.eval_batch_size
+                steps = cohortlib.steps_per_epoch(n, bs)
+                eidx = np.full(steps * bs, -1, np.int32)
+                eidx[:n] = np.arange(n, dtype=np.int32)
+                self._train_eval_idx = jax.device_put(
+                    jnp.asarray(eidx.reshape(steps, bs)), self._rep
+                )
+                self._eval_gather_fn = jax.jit(self._eval_gather_impl)
+            else:
+                self._train_eval_batches = cohortlib.batch_array(
+                    train_data.arrays, config.eval_batch_size
+                )
 
     # -- jitted programs -----------------------------------------------------
 
@@ -233,6 +284,33 @@ class FedSim:
         }
         return new_global, server_state, metrics
 
+    def _gather_round_impl(self, global_variables, server_state, dataset, idx,
+                           weights, num_steps, rng):
+        # Build this shard's batch stack on device: ``idx`` [C_local, S, B]
+        # indexes dataset rows, -1 marks an empty padding slot. Semantics
+        # mirror cohort.stack_cohort exactly (zero-fill + example mask,
+        # token masks multiplied by example validity).
+        valid = (idx >= 0).astype(jnp.float32)
+        safe = jnp.maximum(idx, 0).reshape(-1)
+        batches = {
+            k: jnp.take(v, safe, axis=0).reshape(idx.shape + v.shape[1:])
+            for k, v in dataset.items()
+        }
+        # zero-fill padding slots so the stack is bit-identical to the host
+        # staging path (stack_cohort's np.zeros initialization); this also
+        # folds example validity into a per-token "mask" field if present
+        batches = {
+            k: v * valid.reshape(valid.shape + (1,) * (v.ndim - 3)).astype(v.dtype)
+            for k, v in batches.items()
+        }
+        if "mask" in dataset:
+            batches["mask"] = batches["mask"].astype(jnp.float32)
+        else:
+            batches["mask"] = valid
+        return self._round_impl(
+            global_variables, server_state, batches, weights, num_steps, rng
+        )
+
     def _eval_impl(self, variables, batches):
         def step(carry, batch):
             return carry, self.trainer.eval_batch(variables, batch)
@@ -244,6 +322,24 @@ class FedSim:
             "Acc": summed["test_correct"] / total,
             "Loss": summed["test_loss"] / total,
         }
+
+    def _eval_gather_impl(self, variables, dataset, idx):
+        # pooled-eval analogue of _gather_round_impl: idx [S, B], -1 = pad
+        valid = (idx >= 0).astype(jnp.float32)
+        safe = jnp.maximum(idx, 0).reshape(-1)
+        batches = {
+            k: jnp.take(v, safe, axis=0).reshape(idx.shape + v.shape[1:])
+            for k, v in dataset.items()
+        }
+        batches = {
+            k: v * valid.reshape(valid.shape + (1,) * (v.ndim - 2)).astype(v.dtype)
+            for k, v in batches.items()
+        }
+        if "mask" in dataset:
+            batches["mask"] = batches["mask"].astype(jnp.float32)
+        else:
+            batches["mask"] = valid
+        return self._eval_impl(variables, batches)
 
     # -- host driver ---------------------------------------------------------
 
@@ -291,17 +387,7 @@ class FedSim:
         batches, weights = cohortlib.stack_cohort(
             self.train_data, cohort, cfg.batch_size, steps=self._steps, rng=shuffle
         )
-        # Per-client local-step budgets (scan-step units): stragglers run a
-        # reduced epoch count e_i, i.e. the first e_i * steps-per-epoch steps.
-        if cfg.straggler_frac > 0.0:
-            from fedml_tpu.algorithms.fedprox import straggler_epochs
-
-            epochs_arr = straggler_epochs(
-                round_idx, len(cohort), cfg.epochs, cfg.straggler_frac, cfg.seed
-            )
-        else:
-            epochs_arr = np.full(len(cohort), cfg.epochs, np.int32)
-        num_steps = (epochs_arr * self._steps).astype(np.int32)
+        num_steps = self._round_budgets(cohort, round_idx)
         # Pad the cohort axis to a multiple of the mesh's client axis with
         # zero-weight dummy clients (fully masked, excluded from the weighted
         # aggregation) so the stack shards evenly over devices.
@@ -324,22 +410,81 @@ class FedSim:
         )
         return batches, weights, num_steps
 
-    def stage_round(self, round_idx: int):
-        """Sample the round's cohort and stage its data on device."""
+    def _round_budgets(self, cohort, round_idx: int) -> np.ndarray:
+        """Per-client local-step budgets (scan-step units): stragglers run a
+        reduced epoch count e_i, i.e. the first e_i * steps-per-epoch steps."""
+        cfg = self.config
+        if cfg.straggler_frac > 0.0:
+            from fedml_tpu.algorithms.fedprox import straggler_epochs
+
+            epochs_arr = straggler_epochs(
+                round_idx, len(cohort), cfg.epochs, cfg.straggler_frac, cfg.seed
+            )
+        else:
+            epochs_arr = np.full(len(cohort), cfg.epochs, np.int32)
+        return (epochs_arr * self._steps).astype(np.int32)
+
+    def stage_cohort_indices(self, cohort, round_idx: int):
+        """Device staging for the on-device-dataset path: instead of the full
+        [C, S, B, ...] batch stack, upload only a [C, S, B] int32 index map
+        (-1 = empty slot); the round program gathers rows in HBM."""
+        cfg = self.config
+        slots = self._steps * cfg.batch_size
+        shuffle = (
+            np.random.RandomState(cfg.seed * 1_000_003 + round_idx)
+            if cfg.shuffle_each_round
+            else None
+        )
+        C = len(cohort)
+        idx = np.full((C, slots), -1, np.int32)
+        weights = np.zeros(C, np.float32)
+        for ci, cid in enumerate(cohort):
+            sel = self.train_data.partition[int(cid)]
+            if shuffle is not None:
+                sel = shuffle.permutation(sel)
+            n = min(len(sel), slots)
+            idx[ci, :n] = sel[:n]
+            weights[ci] = len(sel)
+        num_steps = self._round_budgets(cohort, round_idx)
+        n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
+        pad = (-C) % n_dev
+        if pad:
+            idx = np.concatenate([idx, np.full((pad, slots), -1, np.int32)])
+            weights = np.concatenate([weights, np.zeros(pad, np.float32)])
+            num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
+        sharded = meshlib.client_sharded(self.mesh)
+        idx = jax.device_put(
+            jnp.asarray(idx.reshape(-1, self._steps, cfg.batch_size)), sharded
+        )
+        weights = jax.device_put(jnp.asarray(weights), sharded)
+        num_steps = jax.device_put(jnp.asarray(num_steps), sharded)
+        return idx, weights, num_steps
+
+    def _sample_round_cohort(self, round_idx: int) -> np.ndarray:
         cfg = self.config
         if self._per_client:
             # stable identity order: slot i is client i every round, so the
             # persistent stack and the mixing matrix's adjacency line up
-            cohort = np.arange(cfg.client_num_in_total)
-        else:
-            cohort = rnglib.sample_clients(
-                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
-            )
+            return np.arange(cfg.client_num_in_total)
+        return rnglib.sample_clients(
+            round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+        )
+
+    def stage_round(self, round_idx: int):
+        """Sample the round's cohort and stage its data on device."""
+        cohort = self._sample_round_cohort(round_idx)
         return (cohort, *self.stage_cohort(cohort, round_idx))
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
-        _, batches, weights, num_steps = self.stage_round(round_idx)
         rkey = rnglib.round_key(root_rng, round_idx)
+        cohort = self._sample_round_cohort(round_idx)
+        if self._on_device:
+            idx, weights, num_steps = self.stage_cohort_indices(cohort, round_idx)
+            return self._gather_round_fn(
+                global_variables, server_state, self._dataset, idx, weights,
+                num_steps, rkey,
+            )
+        batches, weights, num_steps = self.stage_cohort(cohort, round_idx)
         return self._round_fn(
             global_variables, server_state, batches, weights, num_steps, rkey
         )
@@ -407,7 +552,11 @@ class FedSim:
         if not self._can_eval:
             return {}
         out = {}
-        train_m = self._eval_fn(variables, self._train_eval_batches)
+        train_m = (
+            self._eval_gather_fn(variables, self._dataset, self._train_eval_idx)
+            if self._train_eval_idx is not None
+            else self._eval_fn(variables, self._train_eval_batches)
+        )
         out["Train/Acc"] = float(train_m["Acc"])
         out["Train/Loss"] = float(train_m["Loss"])
         if self._test_batches is not None:
