@@ -78,11 +78,14 @@ def load_partition_data(
     partition_alpha: float = 0.5,
     client_num_in_total: int = 10,
     seed: int = 0,
+    image_size: int | None = None,
+    limit_per_class: int | None = None,
 ) -> FedDataset:
     """Dataset-name dispatch matching the reference experiment scripts'
     ``load_data`` (main_fedavg.py:133-351). Falls back to hermetic synthetic
     fixtures when real files are absent (the reference downloads in CI;
-    we must run offline)."""
+    we must run offline). ``image_size`` / ``limit_per_class`` cap the
+    in-memory decode for the large vision datasets."""
     data_dir = data_dir or f"./data/{dataset}"
 
     if dataset in ("cifar10", "cifar100", "cinic10"):
@@ -157,8 +160,51 @@ def load_partition_data(
         return FedDataset(train, test, 10004, test_fed, name=dataset)
 
     if dataset == "stackoverflow_lr":
+        from fedml_tpu.data import stackoverflow
+
+        if stackoverflow.has_real_files(data_dir):
+            train, test, test_fed, output_dim = stackoverflow.load_stackoverflow_lr(
+                data_dir, limit_clients=client_num_in_total or None
+            )
+            return FedDataset(train, test, output_dim, test_fed, name=dataset)
+        logging.warning("stackoverflow_lr: h5/vocab files absent; using synthetic fixture")
         train, test, test_fed = synthetic_tag_prediction(n_clients=client_num_in_total, seed=seed)
         return FedDataset(train, test, 500, test_fed, name=dataset)
+
+    if dataset in ("ILSVRC2012", "ILSVRC2012_hdf5", "imagenet"):
+        from fedml_tpu.data import vision_fed
+
+        if vision_fed.HAS_PIL and (Path(data_dir) / "train").is_dir():
+            train, test, class_num = vision_fed.load_imagenet(
+                data_dir, client_number=client_num_in_total,
+                image_size=image_size or 224, limit_per_class=limit_per_class,
+            )
+        else:
+            logging.warning("imagenet: %s/train absent (or Pillow missing); "
+                            "using synthetic fixture", data_dir)
+            train, test, class_num = vision_fed.synthetic_imagenet(
+                client_number=client_num_in_total, seed=seed
+            )
+        return FedDataset(train, test, class_num, name=dataset)
+
+    if dataset in ("gld23k", "gld160k", "landmarks"):
+        from fedml_tpu.data import vision_fed
+
+        size = "gld160k" if dataset == "gld160k" else "gld23k"
+        train_csv = Path(data_dir) / "data_user_dict" / f"{size}_user_dict_train.csv"
+        test_csv = Path(data_dir) / "data_user_dict" / f"{size}_user_dict_test.csv"
+        if vision_fed.HAS_PIL and train_csv.exists() and test_csv.exists():
+            train, test, class_num = vision_fed.load_landmarks(
+                Path(data_dir) / "images", train_csv, test_csv,
+                image_size=image_size or 224,
+            )
+        else:
+            logging.warning("%s: mapping csvs absent (or Pillow missing); "
+                            "using synthetic fixture", dataset)
+            train, test, class_num = vision_fed.synthetic_landmarks(
+                n_clients=client_num_in_total, seed=seed
+            )
+        return FedDataset(train, test, class_num, name=dataset)
 
     if dataset.startswith("synthetic"):
         from fedml_tpu.data.synthetic import synthetic_classification
