@@ -174,7 +174,8 @@ def load_partition_data(
     if dataset in ("ILSVRC2012", "ILSVRC2012_hdf5", "imagenet"):
         from fedml_tpu.data import vision_fed
 
-        if vision_fed.HAS_PIL and (Path(data_dir) / "train").is_dir():
+        if (vision_fed.HAS_PIL and (Path(data_dir) / "train").is_dir()
+                and (Path(data_dir) / "val").is_dir()):
             train, test, class_num = vision_fed.load_imagenet(
                 data_dir, client_number=client_num_in_total,
                 image_size=image_size or 224, limit_per_class=limit_per_class,

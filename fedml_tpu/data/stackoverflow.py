@@ -89,10 +89,10 @@ def tags_to_multihot(tag_strs: list[str], tag_dict: dict[str, int]) -> np.ndarra
 
 def _load_split(path: Path, word_dict, tag_dict,
                 client_ids: list[str] | None = None,
-                limit_clients: int | None = None) -> FederatedArrays:
+                limit_clients: int | None = None):
     """``client_ids`` pins the client slot order (slot i = ids[i]); clients
     absent from this archive get an empty shard. Without it, all archive
-    clients load in sorted order."""
+    clients load in sorted order. Returns (FederatedArrays, ids used)."""
     import h5py
 
     V, T = len(word_dict), len(tag_dict)
@@ -118,7 +118,8 @@ def _load_split(path: Path, word_dict, tag_dict,
             cursor += len(sentences)
     if not xs:
         xs, ys = [np.zeros((0, V), np.float32)], [np.zeros((0, T), np.float32)]
-    return FederatedArrays({"x": np.concatenate(xs), "y": np.concatenate(ys)}, part)
+    fa = FederatedArrays({"x": np.concatenate(xs), "y": np.concatenate(ys)}, part)
+    return fa, client_ids
 
 
 def load_stackoverflow_lr(
@@ -133,18 +134,12 @@ def load_stackoverflow_lr(
     d = Path(data_dir)
     word_dict = load_word_dict(d, vocab_size)
     tag_dict = load_tag_dict(d, tag_size)
-    train = _load_split(d / TRAIN_FILE, word_dict, tag_dict,
-                        limit_clients=limit_clients)
+    train, ids = _load_split(d / TRAIN_FILE, word_dict, tag_dict,
+                             limit_clients=limit_clients)
     # pin test slots to the SAME client ids as train: per-client federated
     # eval must score client i's model on client i's own held-out questions
     # (the real test archive's client set is a subset of train's)
-    import h5py
-
-    with h5py.File(d / TRAIN_FILE, "r") as f:
-        ids = sorted(f["examples"].keys())
-    if limit_clients:
-        ids = ids[:limit_clients]
-    test_fed = _load_split(d / TEST_FILE, word_dict, tag_dict, client_ids=ids)
+    test_fed, _ = _load_split(d / TEST_FILE, word_dict, tag_dict, client_ids=ids)
     logging.info(
         "stackoverflow_lr: %d train clients / %d samples, vocab %d, tags %d",
         train.num_clients, train.num_samples, len(word_dict), len(tag_dict),

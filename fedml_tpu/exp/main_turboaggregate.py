@@ -5,17 +5,15 @@ server reconstructs only the SUM of quantized client updates from BGW secret
 shares, never an individual client's plaintext (TA_Aggregator.py:13,
 mpc_function.py:62-110).
 
-This entry runs secure FedAvg rounds: clients BGW-share their sample-weighted
-flattened models, the aggregate is decoded from share sums, and the result is
-checked against the plaintext weighted average (quantization tolerance).
+Runs the real multi-party protocol (algorithms/turboaggregate_dist.py) over
+a comm fabric: clients BGW-share weighted quantized deltas peer-to-peer,
+upload only share-sums, the server reconstructs only the aggregate.
 """
 
 from __future__ import annotations
 
 import argparse
 import logging
-
-import numpy as np
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -25,6 +23,8 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--partition_alpha", type=float, default=0.5)
     parser.add_argument("--client_num_in_total", type=int, default=4)
     parser.add_argument("--privacy_threshold", type=int, default=1)
+    parser.add_argument("--backend", type=str, default="loopback",
+                        choices=["loopback", "shm"])
     parser.add_argument("--batch_size", type=int, default=16)
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--epochs", type=int, default=1)
@@ -36,15 +36,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
 def run(args) -> dict:
     import jax
     import jax.numpy as jnp
+    import numpy as np
     import optax
 
-    from fedml_tpu.algorithms.turboaggregate import secure_sum
-    from fedml_tpu.comm.message import pack_pytree, unpack_pytree
-    from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+    from fedml_tpu.algorithms.turboaggregate_dist import run_turboaggregate
+    from fedml_tpu.comm.managers import create_backend
+    from fedml_tpu.core.trainer import ClientTrainer, make_local_eval
     from fedml_tpu.data import load_partition_data
     from fedml_tpu.models import create_model
     from fedml_tpu.obs.metrics import logging_config
-    from fedml_tpu.sim.cohort import stack_cohort
+    from fedml_tpu.sim.cohort import batch_array
 
     logging_config(0)
     ds = load_partition_data(
@@ -55,38 +56,27 @@ def run(args) -> dict:
     trainer = ClientTrainer(
         module=model, optimizer=optax.sgd(args.lr), epochs=args.epochs
     )
-    n = ds.train.num_clients
+    workers = ds.train.num_clients
 
-    stacks, weights = [], []
-    for c in range(n):
-        stack, w = stack_cohort(ds.train, np.asarray([c]), args.batch_size)
-        stacks.append(jax.tree.map(lambda v: jnp.asarray(v[0]), stack))
-        weights.append(float(w[0]))
-    weights = np.asarray(weights, np.float64)
-    p_i = weights / weights.sum()
+    if args.backend == "loopback":
+        from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
 
-    local_train = jax.jit(make_local_train(trainer))
-    variables = trainer.init(jax.random.key(args.seed), jax.tree.map(lambda v: v[0], stacks[0]))
-    _, desc = pack_pytree(jax.tree.map(np.asarray, variables))
+        fabric = LoopbackFabric(workers + 1)
+        make_comm = lambda r: LoopbackCommManager(fabric, r)  # noqa: E731
+    else:
+        make_comm = lambda r: create_backend(  # noqa: E731
+            "shm", r, workers + 1, job=f"ta{args.seed}"
+        )
 
-    max_gap = 0.0
-    for r in range(args.comm_round):
-        flats = []
-        for c in range(n):
-            out, _ = local_train(variables, stacks[c], jax.random.key(r * 31 + c))
-            flat, _ = pack_pytree(jax.tree.map(np.asarray, out))
-            flats.append(np.ascontiguousarray(flat).view(np.float32) * p_i[c])
-        # server decodes ONLY the sum of shares — never a client's plaintext
-        secure_avg = secure_sum(
-            flats, threshold=args.privacy_threshold, seed=args.seed + r
-        ).astype(np.float32)
-        plain_avg = np.sum(flats, axis=0).astype(np.float32)
-        gap = float(np.max(np.abs(secure_avg - plain_avg)))
-        max_gap = max(max_gap, gap)
-        variables = unpack_pytree(secure_avg.view(np.uint8), desc)
-        logging.info("turboaggregate round %d: secure-vs-plain gap %.2e", r, gap)
+    final = run_turboaggregate(
+        trainer, ds.train, workers, args.comm_round, args.batch_size,
+        make_comm, threshold=args.privacy_threshold, seed=args.seed,
+    )
 
-    out = {"rounds": args.comm_round, "max_quantization_gap": max_gap}
+    batches = jax.tree.map(jnp.asarray, batch_array(ds.test_arrays, 256))
+    m = make_local_eval(trainer)(jax.tree.map(jnp.asarray, final), batches)
+    acc = float(np.asarray(m["test_correct"]) / np.maximum(np.asarray(m["test_total"]), 1))
+    out = {"rounds": args.comm_round, "test_acc": acc}
     logging.info("turboaggregate final: %s", out)
     return out
 

@@ -54,8 +54,11 @@ def test_main_turboaggregate_smoke():
     from fedml_tpu.exp.main_turboaggregate import main
 
     out = main(["--client_num_in_total", "4", "--comm_round", "2"])
-    # secure aggregate equals the plaintext average to quantization tolerance
-    assert out["max_quantization_gap"] < 1e-3
+    # the real multi-party protocol ran to completion and produced an
+    # evaluable model (exactness/privacy are asserted in
+    # tests/test_turboaggregate_dist.py)
+    assert out["rounds"] == 2
+    assert 0.0 <= out["test_acc"] <= 1.0
 
 
 def test_main_fedgan_smoke(tmp_path):
