@@ -150,13 +150,16 @@ class FedAvgClientManager(ClientManager):
 
     def __init__(self, comm: BaseCommunicationManager, rank: int, size: int,
                  trainer: ClientTrainer, train_data: FederatedArrays,
-                 batch_size: int, template_variables: Any):
+                 batch_size: int, template_variables: Any,
+                 local_train_fn=None):
         super().__init__(comm, rank, size)
         self.trainer = trainer
         self.train_data = train_data
         self.batch_size = batch_size
         self.template = template_variables
-        self._local_train = jax.jit(make_local_train(trainer))
+        # override point: cross-silo clients train data-parallel over their
+        # silo mesh (algorithms/cross_silo.py) instead of single-device
+        self._local_train = local_train_fn or jax.jit(make_local_train(trainer))
         self._round = 0
 
     def register_message_receive_handlers(self) -> None:
@@ -189,6 +192,35 @@ class FedAvgClientManager(ClientManager):
         self.send_message(out)
 
 
+
+def init_template(trainer: ClientTrainer, train_arrays: dict, batch_size: int,
+                  seed: int = 0):
+    """Shared harness setup: init the model from a data sample and pack it
+    for the wire. Returns (template pytree, flat bytes, descriptor)."""
+    sample = {
+        name: jnp.asarray(arr[:batch_size]) for name, arr in train_arrays.items()
+    }
+    sample.setdefault("mask", jnp.ones((batch_size,), jnp.float32))
+    template = trainer.init(jax.random.key(seed), sample)
+    template = jax.tree.map(np.asarray, template)
+    flat, desc = pack_pytree(template)
+    return template, flat, desc
+
+
+def run_manager_protocol(server, clients, join_timeout: float = 30.0) -> None:
+    """Shared run harness: client managers in daemon threads, the server's
+    receive loop on the caller thread, graceful join. Used by distributed
+    FedAvg, TurboAggregate, and cross-silo."""
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.register_message_receive_handlers()
+    server.send_init_msg()
+    server.comm.handle_receive_message()  # blocks until the protocol finishes
+    for t in threads:
+        t.join(timeout=join_timeout)
+
+
 def run_distributed_fedavg(
     trainer: ClientTrainer,
     train_data: FederatedArrays,
@@ -205,13 +237,7 @@ def run_distributed_fedavg(
     threads — the single-host harness the reference lacked (SURVEY §4); the
     same managers drive separate processes when the transport spans them.
     Returns the final global variables."""
-    sample = {
-        name: jnp.asarray(arr[:batch_size]) for name, arr in train_data.arrays.items()
-    }
-    sample.setdefault("mask", jnp.ones((batch_size,), jnp.float32))
-    template = trainer.init(jax.random.key(seed), sample)
-    template = jax.tree.map(np.asarray, template)
-    flat, desc = pack_pytree(template)
+    template, flat, desc = init_template(trainer, train_data.arrays, batch_size, seed)
 
     results: dict[str, np.ndarray] = {}
 
@@ -233,14 +259,7 @@ def run_distributed_fedavg(
         for r in range(1, worker_num + 1)
     ]
 
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.register_message_receive_handlers()
-    server.send_init_msg()
-    server.comm.handle_receive_message()  # blocks until round_num done
-    for t in threads:
-        t.join(timeout=30)
+    run_manager_protocol(server, clients)
     return unpack_pytree(results["final"], desc)
 
 

@@ -76,7 +76,9 @@ def bgw_decode(shares: np.ndarray, share_idx: np.ndarray, p: int = DEFAULT_PRIME
     (BGW_decoding)."""
     xs = np.asarray(share_idx, dtype=np.int64) + 1
     lam = lagrange_coefficients(xs, 0, p)
-    return (lam[:, None] * (np.asarray(shares, np.int64) % p)).sum(axis=0) % p
+    # reduce each product mod p before summing: lam_i * s_i < p^2 fits int64,
+    # but a sum of >= 3 unreduced products overflows and wraps silently
+    return (lam[:, None] * (np.asarray(shares, np.int64) % p) % p).sum(axis=0) % p
 
 
 def lcc_encode(data: np.ndarray, n_workers: int, k_batches: int, t_privacy: int = 0,
@@ -95,7 +97,7 @@ def lcc_encode(data: np.ndarray, n_workers: int, k_batches: int, t_privacy: int 
     shares = np.zeros((n_workers, D), dtype=np.int64)
     for w, b in enumerate(beta):
         lam = lagrange_coefficients(alpha, int(b), p)
-        shares[w] = (lam[:, None] * data).sum(axis=0) % p
+        shares[w] = (lam[:, None] * data % p).sum(axis=0) % p
     return shares
 
 
@@ -106,7 +108,9 @@ def lcc_decode(shares: np.ndarray, worker_idx: np.ndarray, k_batches: int,
     out = np.zeros((k_batches, shares.shape[1]), dtype=np.int64)
     for target in range(1, k_batches + 1):
         lam = lagrange_coefficients(beta, target, p)
-        out[target - 1] = (lam[:, None] * (np.asarray(shares, np.int64) % p)).sum(axis=0) % p
+        out[target - 1] = (
+            lam[:, None] * (np.asarray(shares, np.int64) % p) % p
+        ).sum(axis=0) % p
     return out
 
 

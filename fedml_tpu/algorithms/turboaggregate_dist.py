@@ -111,7 +111,6 @@ class TAServerManager(ServerManager):
         self.on_round_done = on_round_done
         self._sample_nums: dict[int, float] = {}
         self._share_sums: dict[int, np.ndarray] = {}
-        self._round_closed = False
         self._timer: threading.Timer | None = None
         self._lock = threading.Lock()
 
@@ -181,8 +180,6 @@ class TAServerManager(ServerManager):
 
     def _close_round(self) -> None:
         with self._lock:
-            if self._round_closed:
-                return
             if len(self._share_sums) < self.threshold + 1:
                 logging.error(
                     "turboaggregate round %d: only %d/%d share-sums after "
@@ -191,9 +188,16 @@ class TAServerManager(ServerManager):
                     self.threshold + 1,
                 )
                 return
-            self._round_closed = True
+            # snapshot AND advance the round inside one critical section:
+            # a straggler's share-sum from the closed round must fail the
+            # round check the moment we commit to reconstructing (the timer
+            # thread and the receive thread race here when round_timeout is
+            # set)
             share_sums = dict(self._share_sums)
             self._share_sums.clear()
+            closed_round = self.round_idx
+            self.round_idx += 1
+            self._timed_out = False
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
@@ -207,10 +211,7 @@ class TAServerManager(ServerManager):
         ).astype(np.float32)
         self.global_flat = new_flat.view(np.uint8)
         if self.on_round_done:
-            self.on_round_done(self.round_idx, self.global_flat)
-        self.round_idx += 1
-        with self._lock:
-            self._round_closed = False
+            self.on_round_done(closed_round, self.global_flat)
         finished = self.round_idx >= self.round_num
         self._send_sync(finished)
         if finished:
@@ -349,13 +350,12 @@ def run_turboaggregate(
 ):
     """End-to-end secure aggregation over any comm fabric (same harness
     shape as run_distributed_fedavg). Returns the final global variables."""
-    sample = {
-        name: jnp.asarray(arr[:batch_size]) for name, arr in train_data.arrays.items()
-    }
-    sample.setdefault("mask", jnp.ones((batch_size,), jnp.float32))
-    template = trainer.init(jax.random.key(seed), sample)
-    template = jax.tree.map(np.asarray, template)
-    flat, desc = pack_pytree(template)
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        init_template,
+        run_manager_protocol,
+    )
+
+    template, flat, desc = init_template(trainer, train_data.arrays, batch_size, seed)
     non_f32 = [leaf.dtype for leaf in jax.tree.leaves(template)
                if np.asarray(leaf).dtype != np.float32]
     if non_f32:
@@ -382,14 +382,7 @@ def run_turboaggregate(
         )
         for r in range(1, worker_num + 1)
     ]
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
-    for t in threads:
-        t.start()
-    server.register_message_receive_handlers()
-    server.send_init_msg()
-    server.comm.handle_receive_message()
-    for t in threads:
-        t.join(timeout=30)
+    run_manager_protocol(server, clients)
     if "final" not in results:
         raise RuntimeError("turboaggregate run produced no final model")
     logging.info("turboaggregate: %d rounds complete", round_num)

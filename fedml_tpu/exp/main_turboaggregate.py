@@ -40,7 +40,7 @@ def run(args) -> dict:
     import optax
 
     from fedml_tpu.algorithms.turboaggregate_dist import run_turboaggregate
-    from fedml_tpu.comm.managers import create_backend
+    from fedml_tpu.comm.managers import create_backend  # noqa: F401 (shm path)
     from fedml_tpu.core.trainer import ClientTrainer, make_local_eval
     from fedml_tpu.data import load_partition_data
     from fedml_tpu.models import create_model
@@ -58,20 +58,30 @@ def run(args) -> dict:
     )
     workers = ds.train.num_clients
 
+    made = []
     if args.backend == "loopback":
         from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
 
         fabric = LoopbackFabric(workers + 1)
         make_comm = lambda r: LoopbackCommManager(fabric, r)  # noqa: E731
     else:
-        make_comm = lambda r: create_backend(  # noqa: E731
-            "shm", r, workers + 1, job=f"ta{args.seed}"
-        )
+        import uuid
 
-    final = run_turboaggregate(
-        trainer, ds.train, workers, args.comm_round, args.batch_size,
-        make_comm, threshold=args.privacy_threshold, seed=args.seed,
-    )
+        job = f"ta_{uuid.uuid4().hex[:8]}"
+
+        def make_comm(r):
+            m = create_backend("shm", r, workers + 1, job=job)
+            made.append(m)
+            return m
+
+    try:
+        final = run_turboaggregate(
+            trainer, ds.train, workers, args.comm_round, args.batch_size,
+            make_comm, threshold=args.privacy_threshold, seed=args.seed,
+        )
+    finally:
+        for m in made:
+            m.cleanup()
 
     batches = jax.tree.map(jnp.asarray, batch_array(ds.test_arrays, 256))
     m = make_local_eval(trainer)(jax.tree.map(jnp.asarray, final), batches)

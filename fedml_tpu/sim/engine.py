@@ -131,6 +131,9 @@ class FedSim:
         self._rep = meshlib.replicated(self.mesh)
         self._shard = meshlib.cohort_batch_sharding(self.mesh)
         self._n_client_shards = self.mesh.shape[meshlib.CLIENT_AXIS]
+        # multi-controller (jax.distributed) jobs: every process stages the
+        # same host arrays but materializes only its addressable shards
+        self._multihost = jax.process_count() > 1
 
         # The round program is shard_mapped manually over the ``clients`` axis:
         # each device runs an ordinary vmap over its local cohort slice, then
@@ -168,8 +171,8 @@ class FedSim:
             else nbytes <= 2 << 30
         )
         if self._on_device:
-            self._dataset = jax.device_put(
-                {k: jnp.asarray(v) for k, v in train_data.arrays.items()},
+            self._dataset = self._put(
+                {k: np.asarray(v) for k, v in train_data.arrays.items()},
                 self._rep,
             )
             self._gather_round_fn = jax.jit(
@@ -189,9 +192,7 @@ class FedSim:
         if test_arrays is not None and self._can_eval:
             b = cohortlib.batch_array(test_arrays, config.eval_batch_size)
             self._test_batches = (
-                jax.device_put(jax.tree.map(jnp.asarray, b), self._rep)
-                if self._on_device
-                else b
+                self._put(b, self._rep) if self._on_device else b
             )
         # Pooled train eval: on-device mode gathers eval batches from the
         # already-resident dataset (an index map, not a second copy of the
@@ -205,14 +206,27 @@ class FedSim:
                 steps = cohortlib.steps_per_epoch(n, bs)
                 eidx = np.full(steps * bs, -1, np.int32)
                 eidx[:n] = np.arange(n, dtype=np.int32)
-                self._train_eval_idx = jax.device_put(
-                    jnp.asarray(eidx.reshape(steps, bs)), self._rep
+                self._train_eval_idx = self._put(
+                    eidx.reshape(steps, bs), self._rep
                 )
                 self._eval_gather_fn = jax.jit(self._eval_gather_impl)
             else:
                 self._train_eval_batches = cohortlib.batch_array(
                     train_data.arrays, config.eval_batch_size
                 )
+
+
+    def _put(self, value, sharding):
+        """device_put that also works when ``self.mesh`` spans processes
+        (multi-controller): each process supplies only the shards it owns
+        (parallel/multihost.py staging discipline)."""
+        if not self._multihost:
+            return jax.device_put(value, sharding)
+        from fedml_tpu.parallel.multihost import stage_global
+
+        return jax.tree.map(
+            lambda leaf: stage_global(np.asarray(leaf), sharding), value
+        )
 
     # -- jitted programs -----------------------------------------------------
 
@@ -358,13 +372,13 @@ class FedSim:
         the standard decentralized-optimization setup)."""
         v = self.init_variables()
         if not self._per_client:
-            return jax.device_put(v, self._rep)
+            return self._put(v, self._rep)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
         c_pad = -(-self.config.client_num_in_total // n_dev) * n_dev
         stacked = jax.tree.map(
-            lambda l: jnp.broadcast_to(l[None], (c_pad,) + l.shape), v
+            lambda l: np.broadcast_to(np.asarray(l)[None], (c_pad,) + l.shape), v
         )
-        return jax.device_put(stacked, meshlib.client_sharded(self.mesh))
+        return self._put(stacked, meshlib.client_sharded(self.mesh))
 
     def consensus(self, variables: Pytree) -> Pytree:
         """A single evaluable model: identity in broadcast mode; the node
@@ -401,13 +415,9 @@ class FedSim:
             }
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
-        batches = jax.device_put(batches, self._shard)
-        weights = jax.device_put(
-            jnp.asarray(weights), meshlib.client_sharded(self.mesh)
-        )
-        num_steps = jax.device_put(
-            jnp.asarray(num_steps), meshlib.client_sharded(self.mesh)
-        )
+        batches = self._put(batches, self._shard)
+        weights = self._put(weights, meshlib.client_sharded(self.mesh))
+        num_steps = self._put(num_steps, meshlib.client_sharded(self.mesh))
         return batches, weights, num_steps
 
     def _round_budgets(self, cohort, round_idx: int) -> np.ndarray:
@@ -453,11 +463,9 @@ class FedSim:
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
         sharded = meshlib.client_sharded(self.mesh)
-        idx = jax.device_put(
-            jnp.asarray(idx.reshape(-1, self._steps, cfg.batch_size)), sharded
-        )
-        weights = jax.device_put(jnp.asarray(weights), sharded)
-        num_steps = jax.device_put(jnp.asarray(num_steps), sharded)
+        idx = self._put(idx.reshape(-1, self._steps, cfg.batch_size), sharded)
+        weights = self._put(weights, sharded)
+        num_steps = self._put(num_steps, sharded)
         return idx, weights, num_steps
 
     def _sample_round_cohort(self, round_idx: int) -> np.ndarray:

@@ -1,0 +1,52 @@
+"""Worker process for tests/test_multihost.py: joins the jax.distributed
+job, runs FedSim over the global (cross-process) clients mesh, and writes
+its view of the final model to an npz. Run as:
+``python tests/_multihost_worker.py <pid> <nprocs> <port> <out.npz>``"""
+
+import sys
+
+
+def main(process_id: int, num_processes: int, port: int, out_path: str) -> None:
+    from fedml_tpu.parallel.multihost import global_client_mesh, init_multihost
+
+    init_multihost(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_count=2,
+        platform="cpu",
+    )
+
+    import numpy as np
+    import optax
+
+    import jax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(
+        n_clients=8, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=2
+    )
+    cfg = SimConfig(
+        client_num_in_total=8, client_num_per_round=4, batch_size=8,
+        comm_round=3, epochs=2, frequency_of_the_test=3, seed=0,
+    )
+    mesh = global_client_mesh()
+    assert mesh.devices.size == num_processes * 2, mesh.devices.shape
+    sim = FedSim(trainer, train, test, cfg, mesh=mesh)
+    variables, history = sim.run()
+    # every controller sees the same replicated result
+    flat = np.concatenate([
+        np.ravel(np.asarray(l)) for l in jax.tree.leaves(variables)
+    ])
+    np.savez(out_path, flat=flat, test_acc=history[-1]["Test/Acc"])
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]), int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])

@@ -213,6 +213,19 @@ def test_mpc_bgw_roundtrip():
     np.testing.assert_array_equal(rec, secret)
 
 
+def test_mpc_bgw_no_int64_overflow():
+    # regression: with >= 3 reconstruction terms, products lam_i * s_i near
+    # p^2 used to be summed UNreduced, overflowing int64 and wrapping —
+    # decode from 4 and 5 shares with adversarially large share values
+    secret = np.asarray([3, 2**30, mpc.DEFAULT_PRIME - 2], dtype=np.int64)
+    for t in (2, 3):
+        shares = mpc.bgw_encode(secret, n_shares=7, threshold=t, seed=123)
+        idx = np.arange(t + 1)
+        np.testing.assert_array_equal(mpc.bgw_decode(shares[idx], idx), secret)
+        idx2 = np.asarray([0, 2, 4, 6][: t + 1])
+        np.testing.assert_array_equal(mpc.bgw_decode(shares[idx2], idx2), secret)
+
+
 def test_mpc_lcc_roundtrip():
     data = np.arange(12, dtype=np.int64).reshape(3, 4) + 100
     shares = mpc.lcc_encode(data, n_workers=7, k_batches=3, t_privacy=1, seed=1)
