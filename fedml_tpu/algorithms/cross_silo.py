@@ -27,6 +27,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from fedml_tpu.algorithms.fedavg_distributed import (
     FedAvgClientManager,
     FedAvgServerManager,
+    init_template,
+    run_manager_protocol,
 )
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import unpack_pytree
@@ -84,11 +86,6 @@ def run_cross_silo(
         # one silo group spanning the local devices (clients axis size 1:
         # within a silo manager, the silo IS the single client)
         silo_meshes = [meshlib.silo_mesh(1)] * n_silos
-
-    from fedml_tpu.algorithms.fedavg_distributed import (
-        init_template,
-        run_manager_protocol,
-    )
 
     template, flat, desc = init_template(
         trainer, silo_data[0].arrays, batch_size, seed

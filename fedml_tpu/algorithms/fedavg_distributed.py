@@ -325,12 +325,12 @@ def run_distributed_fedavg_grpc(
     """Distributed FedAvg over localhost gRPC (cross-host transport run
     single-host; an ip_config table generalizes it to a cluster, reference
     grpc_ipconfig.csv)."""
-    from fedml_tpu.comm.grpc_backend import GrpcCommManager
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
 
     ip_config = {
         r: ("127.0.0.1", base_port + r) for r in range(worker_num + 1)
     }
-    mgrs = {r: GrpcCommManager(r, ip_config) for r in range(worker_num + 1)}
+    mgrs = {r: GRPCCommManager(r, ip_config) for r in range(worker_num + 1)}
     try:
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,

@@ -180,6 +180,8 @@ class TAServerManager(ServerManager):
 
     def _close_round(self) -> None:
         with self._lock:
+            if not self._share_sums:
+                return  # benign double close (timer raced the full tally)
             if len(self._share_sums) < self.threshold + 1:
                 logging.error(
                     "turboaggregate round %d: only %d/%d share-sums after "

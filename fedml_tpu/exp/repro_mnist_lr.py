@@ -1,0 +1,162 @@
+"""BASELINE.md reproduction: MNIST + LogisticRegression, Linear-Models row 1.
+
+Reference config (benchmark/README.md:12-14): LEAF MNIST, 1000 clients
+(power-law), 10 clients/round, batch 10, SGD lr 0.03, E=1 — test accuracy
+crosses 75 within ~100 rounds.
+
+Runs on the real LEAF files when ``--data_dir`` has them; otherwise
+generates the offline LEAF-format fixture (data/leaf_fixture.py — real
+sklearn handwriting, power-law/2-class partition; NOT byte-identical MNIST,
+and REPRO.md says so). Writes repro_metrics.jsonl + REPRO.md.
+
+Usage: python -m fedml_tpu.exp.repro_mnist_lr [--comm_round 150] [--out REPRO.md]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+from pathlib import Path
+
+
+def run(args) -> dict:
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data import load_partition_data
+    from fedml_tpu.data.leaf_fixture import write_leaf_mnist_fixture
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs.metrics import logging_config
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    logging_config(0)
+    data_dir = Path(args.data_dir)
+    real = (data_dir / "train").is_dir() and any((data_dir / "train").glob("*.json"))
+    if not real:
+        logging.info("no LEAF files at %s — generating offline fixture", data_dir)
+        write_leaf_mnist_fixture(data_dir, n_clients=args.client_num_in_total,
+                                 seed=args.seed)
+    ds = load_partition_data("mnist", str(data_dir),
+                             client_num_in_total=args.client_num_in_total)
+
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=10),
+        optimizer=optax.sgd(args.lr),
+        epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=ds.train.num_clients,
+        client_num_per_round=args.client_num_per_round,
+        batch_size=args.batch_size,
+        comm_round=args.comm_round,
+        epochs=1,
+        frequency_of_the_test=args.frequency_of_the_test,
+        seed=args.seed,
+    )
+    sim = FedSim(trainer, ds.train, ds.test_arrays, cfg)
+
+    metrics_path = Path(args.metrics_out)
+    records = []
+    t0 = time.time()
+    with open(metrics_path, "w") as f:
+        def cb(rec):
+            records.append(rec)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+
+        sim.run(callback=cb)
+    wall = time.time() - t0
+
+    evals = [r for r in records if "Test/Acc" in r]
+    best = max(e["Test/Acc"] for e in evals)
+    first_over_75 = next(
+        (e["round"] for e in evals if e["Test/Acc"] > 0.75), None
+    )
+    rounds_per_sec = cfg.comm_round / wall
+    result = {
+        "dataset": "LEAF MNIST" if real else "LEAF-format offline fixture",
+        "clients": ds.train.num_clients,
+        "samples": ds.train.num_samples,
+        "rounds": cfg.comm_round,
+        "best_test_acc": round(best, 4),
+        "first_round_over_75": first_over_75,
+        "rounds_per_sec": round(rounds_per_sec, 2),
+        "final": {k: round(v, 4) for k, v in evals[-1].items() if k != "round"},
+    }
+    if args.out:
+        _write_report(Path(args.out), args, result, evals)
+    logging.info("repro result: %s", result)
+    return result
+
+
+def _write_report(path: Path, args, result: dict, evals: list) -> None:
+    curve = "\n".join(
+        f"| {e['round']} | {e['Train/Acc']:.4f} | {e['Test/Acc']:.4f} |"
+        for e in evals
+    )
+    fixture_note = (
+        "Real LEAF MNIST files were used."
+        if result["dataset"] == "LEAF MNIST"
+        else (
+            "**Data note:** this environment has no network egress, so the real "
+            "LEAF MNIST download is unavailable. The run uses the LEAF-format "
+            "offline fixture (`fedml_tpu/data/leaf_fixture.py`): real sklearn "
+            "handwritten digits (8x8 upsampled to 28x28, augmented), power-law "
+            "client sizes, 2 classes/client — the FedProx partition shape. It is "
+            "NOT byte-identical MNIST; treat the accuracy as evidence the "
+            "pipeline reproduces the reference's convergence behavior on "
+            "MNIST-shaped data, not as a literal MNIST score."
+        )
+    )
+    path.write_text(f"""# BASELINE reproduction — MNIST + LogisticRegression (Linear Models row 1)
+
+Reference target (BASELINE.md / benchmark/README.md:12-14): test acc **> 75**
+within **~100 rounds** — 1000 clients (power-law), 10/round, B=10, SGD
+lr=0.03, E=1.
+
+{fixture_note}
+
+## Config
+
+| clients | per round | batch | lr | local epochs | rounds |
+|---|---|---|---|---|---|
+| {result['clients']} | {args.client_num_per_round} | {args.batch_size} | {args.lr} | 1 | {result['rounds']} |
+
+## Result
+
+- best test accuracy: **{result['best_test_acc'] * 100:.2f}**
+- first round with test acc > 75: **{result['first_round_over_75']}**
+- wall-clock: {result['rounds_per_sec']} rounds/sec on this chip
+- raw per-round metrics: `repro_metrics.jsonl`
+
+## Accuracy curve (eval every {args.frequency_of_the_test} rounds)
+
+| round | train acc | test acc |
+|---|---|---|
+{curve}
+""")
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument("--data_dir", type=str, default="./data/mnist")
+    parser.add_argument("--client_num_in_total", type=int, default=1000)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.03)
+    parser.add_argument("--comm_round", type=int, default=150)
+    parser.add_argument("--frequency_of_the_test", type=int, default=5)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--metrics_out", type=str, default="repro_metrics.jsonl")
+    parser.add_argument("--out", type=str, default="REPRO.md")
+    return parser
+
+
+def main(argv=None):
+    args = add_args(argparse.ArgumentParser("mnist+lr baseline repro")).parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,83 @@
+"""Model export for mobile / serving targets (the MNN-conversion role).
+
+Reference: fedml_api/model/mobile/model_transfer.py:19,51 — torch<->MNN
+weight transfer via aligned flat layer lists, so a phone-side MNN runtime
+and the server-side torch model exchange parameters during federated
+rounds.
+
+TPU-native equivalents:
+
+1. :func:`export_stablehlo` / :func:`load_stablehlo` — serialize a jitted
+   forward pass as portable StableHLO (``jax.export``). StableHLO is the
+   deployment interchange format of the XLA ecosystem: the artifact runs
+   under any StableHLO-consuming runtime (IREE and friends on mobile,
+   TF/LiteRT converters, server runtimes) without Python or Flax.
+2. :func:`params_to_flat_list` / :func:`flat_list_to_params` — the aligned
+   flat-layer-list contract itself (model_transfer.py's mnn_pytorch /
+   pytorch_mnn round-trip): a deterministic leaf ordering so an on-device
+   runtime holding "a list of weight arrays" can exchange parameters with
+   the server model, both directions, loss-free.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+import jax
+
+Pytree = Any
+
+
+# -- aligned flat-list weight transfer (model_transfer.py role) --------------
+
+
+def params_to_flat_list(params: Pytree) -> list[np.ndarray]:
+    """Deterministic (path-sorted) list of weight arrays — the mobile
+    runtime's model format."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    leaves.sort(key=lambda kv: jax.tree_util.keystr(kv[0]))
+    return [np.asarray(v) for _, v in leaves]
+
+
+def flat_list_to_params(flat: list[np.ndarray], template: Pytree) -> Pytree:
+    """Inverse of :func:`params_to_flat_list` given any same-structure
+    template (shape-checked, like the reference's aligned-layer assert)."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    order = sorted(range(len(paths)), key=lambda i: jax.tree_util.keystr(paths[i][0]))
+    if len(flat) != len(paths):
+        raise ValueError(
+            f"model format is not aligned: {len(flat)} arrays vs "
+            f"{len(paths)} leaves"
+        )
+    leaves = [None] * len(paths)
+    for slot, arr in zip(order, flat):
+        want = np.shape(paths[slot][1])
+        arr = np.asarray(arr)
+        if arr.shape != want:
+            arr = arr.reshape(want)  # reference reshapes on mismatch too
+        leaves[slot] = arr
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# -- StableHLO export (deployment artifact) ----------------------------------
+
+
+def export_stablehlo(apply_fn, example_args: tuple, path: str | Path) -> bytes:
+    """Serialize ``jit(apply_fn)(*example_args)`` as a portable StableHLO
+    artifact; also writes it to ``path``. Returns the serialized bytes."""
+    from jax import export as jexport
+
+    exported = jexport.export(jax.jit(apply_fn))(*example_args)
+    blob = exported.serialize()
+    Path(path).write_bytes(blob)
+    return blob
+
+
+def load_stablehlo(path: str | Path):
+    """Deserialize a StableHLO artifact; ``.call(*args)`` runs it."""
+    from jax import export as jexport
+
+    return jexport.deserialize(Path(path).read_bytes())

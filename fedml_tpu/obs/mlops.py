@@ -1,0 +1,154 @@
+"""MLOps telemetry: the reference's topic protocol over pluggable messengers.
+
+Reference: fedml_core/mlops_logger.py:15 — a singleton publishing client/
+server status, training metrics, round info, model info, and system
+performance as JSON to fixed MQTT topics (``fl_client/mlops/status``,
+``fl_server/mlops/training_progress_and_eval``, ...). The MLOps platform
+subscribes to those topics.
+
+Here the logger keeps the reference's exact topic names and payload keys so
+an MLOps consumer sees the same wire protocol, but the transport is a
+pluggable ``messenger`` with ``send_message_json(topic, payload_json)``:
+
+- :class:`MqttMessenger` — real MQTT broker (production; requires paho).
+- :class:`FileMessenger` — JSONL sink (offline runs, tests, and audit logs).
+
+No singleton: construct one logger per run and pass it around — global
+mutable state was a reference defect, not a feature.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Protocol
+
+from fedml_tpu.obs.sysstats import SysStats
+
+# reference topic names (mlops_logger.py:32-110), kept verbatim
+TOPIC_CLIENT_STATUS = "fl_client/mlops/status"
+TOPIC_CLIENT_ID_STATUS = "fl_client/mlops/{edge_id}/status"
+TOPIC_SERVER_STATUS = "fl_server/mlops/status"
+TOPIC_SERVER_ID_STATUS = "fl_server/mlops/id/status"
+TOPIC_CLIENT_METRICS = "fl_client/mlops/training_metrics"
+TOPIC_SERVER_METRICS = "fl_server/mlops/training_progress_and_eval"
+TOPIC_ROUND_INFO = "fl_client/mlops/training_roundx"
+TOPIC_CLIENT_MODEL = "fl_server/mlops/client_model"
+TOPIC_AGGREGATED_MODEL = "fl_server/mlops/global_aggregated_model"
+TOPIC_SYSTEM = "fl_client/mlops/system_performance"
+
+
+class Messenger(Protocol):
+    def send_message_json(self, topic: str, payload_json: str) -> None: ...
+
+
+class FileMessenger:
+    """JSONL sink: one ``{"ts", "topic", "payload"}`` record per message."""
+
+    def __init__(self, path: str | Path):
+        self.path = Path(path)
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._fh = open(self.path, "a")
+
+    def send_message_json(self, topic: str, payload_json: str) -> None:
+        rec = {"ts": time.time(), "topic": topic, "payload": json.loads(payload_json)}
+        self._fh.write(json.dumps(rec) + "\n")
+        self._fh.flush()
+
+    def close(self) -> None:
+        self._fh.close()
+
+
+class MqttMessenger:
+    """Publishes each topic to a real MQTT broker (paho-mqtt)."""
+
+    def __init__(self, host: str = "localhost", port: int = 1883,
+                 client_id: str = "fedml_tpu_mlops"):
+        import paho.mqtt.client as mqtt  # gated: optional dependency
+
+        if hasattr(mqtt, "CallbackAPIVersion"):  # paho >= 2.0
+            self._client = mqtt.Client(
+                mqtt.CallbackAPIVersion.VERSION1, client_id=client_id
+            )
+        else:
+            self._client = mqtt.Client(client_id=client_id)
+        self._client.connect(host, port)
+        self._client.loop_start()
+
+    def send_message_json(self, topic: str, payload_json: str) -> None:
+        self._client.publish(topic, payload_json, qos=1)
+
+    def close(self) -> None:
+        self._client.loop_stop()
+        self._client.disconnect()
+
+
+class MLOpsLogger:
+    """Reference-protocol telemetry reporter (mlops_logger.py API names)."""
+
+    def __init__(self, messenger: Messenger, run_id: Any = None, edge_id: Any = None):
+        self.messenger = messenger
+        self.run_id = run_id
+        self.edge_id = edge_id
+        self._sys = SysStats()
+
+    def _send(self, topic: str, msg: dict) -> None:
+        payload = json.dumps(msg)
+        logging.debug("mlops %s: %s", topic, payload)
+        self.messenger.send_message_json(topic, payload)
+
+    # -- status (reference :32-57) -----------------------------------------
+    def report_client_training_status(self, edge_id, status) -> None:
+        self._send(TOPIC_CLIENT_STATUS, {"edge_id": edge_id, "status": status})
+
+    def report_client_id_status(self, run_id, edge_id, status) -> None:
+        self._send(
+            TOPIC_CLIENT_ID_STATUS.format(edge_id=edge_id),
+            {"run_id": run_id, "edge_id": edge_id, "status": status},
+        )
+
+    def report_server_training_status(self, run_id, status) -> None:
+        self._send(TOPIC_SERVER_STATUS, {"run_id": run_id, "status": status})
+
+    def report_server_id_status(self, run_id, status) -> None:
+        self._send(TOPIC_SERVER_ID_STATUS, {"run_id": run_id, "status": status})
+
+    # -- metrics / round / model info (reference :59-88) --------------------
+    def report_client_training_metric(self, metric: dict) -> None:
+        self._send(TOPIC_CLIENT_METRICS, metric)
+
+    def report_server_training_metric(self, metric: dict) -> None:
+        self._send(TOPIC_SERVER_METRICS, metric)
+
+    def report_server_training_round_info(self, round_info: dict) -> None:
+        self._send(TOPIC_ROUND_INFO, round_info)
+
+    def report_client_model_info(self, model_info: dict) -> None:
+        self._send(TOPIC_CLIENT_MODEL, model_info)
+
+    def report_aggregated_model_info(self, model_info: dict) -> None:
+        self._send(TOPIC_AGGREGATED_MODEL, model_info)
+
+    # -- system performance (reference :90-110) -----------------------------
+    def report_system_metric(self, metric: dict | None = None) -> None:
+        if metric is None:
+            metric = {"run_id": self.run_id, "edge_id": self.edge_id}
+            metric.update(self._sys.sample())
+        self._send(TOPIC_SYSTEM, metric)
+
+    def round_callback(self):
+        """A FedSim ``callback`` that streams every round record as a server
+        training metric plus round info — wiring the engine's history into
+        the MLOps protocol."""
+
+        def cb(rec: dict) -> None:
+            self.report_server_training_metric(
+                {"run_id": self.run_id, **rec}
+            )
+            self.report_server_training_round_info(
+                {"run_id": self.run_id, "round_index": rec.get("round")}
+            )
+
+        return cb

@@ -1,0 +1,66 @@
+"""Synchronized BatchNorm across the silo (in-silo data-parallel) axis.
+
+Reference: fedml_api/model/cv/batchnorm_utils.py — ~400 lines of
+master/slave thread pipes (SyncMaster, SlavePipe, FutureResult) to gather
+per-GPU batch moments under torch DataParallel and broadcast the global
+statistics back.
+
+On TPU the whole mechanism is one argument: Flax's BatchNorm takes
+``axis_name``, and when the batch axis is sharded over a mesh axis inside
+``shard_map``/``pjit``, the mean/variance reduction becomes a ``psum`` over
+that axis — XLA schedules it on ICI like any other collective. This module
+pins the framework policy:
+
+- ``SyncBatchNorm`` — BatchNorm synchronized over the ``silo`` axis: batch
+  statistics are computed over the FULL per-client batch even when it is
+  sharded across the silo's devices (exactly what the reference's
+  SynchronizedBatchNorm2d does across DataParallel replicas).
+- Cross-CLIENT statistics are deliberately NOT synchronized: each client's
+  BN sees only its own data (federated semantics); the running averages are
+  then federated like ordinary weights (FedAVGAggregator.py:74-81 policy,
+  see core/trainer.py module docstring).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+
+from fedml_tpu.parallel.mesh import SILO_AXIS
+
+
+class SyncBatchNorm(nn.Module):
+    """Drop-in BatchNorm whose batch statistics reduce over the silo axis.
+
+    Use inside models trained with the silo mesh axis (cross-silo in-silo
+    data parallelism, parallel/mesh.py cohort_batch_sharding). Outside a
+    mapped context (no ``silo`` axis bound), it behaves as plain BatchNorm —
+    same module code runs in single-device tests and sharded training.
+    """
+
+    use_running_average: bool | None = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    axis_name: str | None = SILO_AXIS
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool | None = None):
+        # bind the axis only when it exists in the current mapped context
+        axis = self.axis_name
+        if axis is not None:
+            try:
+                import jax
+
+                jax.lax.axis_index(axis)
+            except NameError:  # unbound: plain (single-replica) BatchNorm
+                axis = None
+        return nn.BatchNorm(
+            use_running_average=(
+                use_running_average
+                if use_running_average is not None
+                else self.use_running_average
+            ),
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            axis_name=axis,
+            name="bn",
+        )(x)

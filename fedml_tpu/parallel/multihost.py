@@ -45,11 +45,19 @@ def init_multihost(
     site-level platform pin); both must run before first jax use.
     """
     if local_device_count is not None:
+        import re
+
         flags = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count={local_device_count}"
-            ).strip()
+        opt = f"--xla_force_host_platform_device_count={local_device_count}"
+        if "xla_force_host_platform_device_count" in flags:
+            # an inherited value (e.g. a test harness's =8) must not
+            # silently override the caller's explicit topology
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", opt, flags
+            )
+            os.environ["XLA_FLAGS"] = flags
+        else:
+            os.environ["XLA_FLAGS"] = (flags + " " + opt).strip()
 
     import jax
 
@@ -70,16 +78,18 @@ def is_multihost() -> bool:
 
 def global_client_mesh(silo: int = 1):
     """A mesh over every device in the job (all hosts), clients x silo —
-    the multi-host version of parallel.mesh.client_mesh/silo_mesh."""
+    the multi-host version of parallel.mesh.client_mesh/silo_mesh (same
+    axis names and argument convention: ``silo`` is the silo-group size)."""
     import jax
-    from jax.sharding import Mesh
 
-    devices = np.asarray(jax.devices())
+    from fedml_tpu.parallel import mesh as meshlib
+
+    devices = list(jax.devices())
     if silo > 1:
         if len(devices) % silo:
             raise ValueError(f"{len(devices)} devices not divisible by silo={silo}")
-        return Mesh(devices.reshape(-1, silo), ("clients", "silo"))
-    return Mesh(devices, ("clients",))
+        return meshlib.silo_mesh(len(devices) // silo, devices)
+    return meshlib.client_mesh(devices)
 
 
 def stage_global(host_array: np.ndarray, sharding):

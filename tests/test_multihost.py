@@ -52,9 +52,15 @@ def test_two_process_fedavg_matches_single_process(tmp_path):
         for i in range(2)
     ]
     logs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=300)
-        logs.append(out.decode(errors="replace"))
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            logs.append(out.decode(errors="replace"))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)[-4000:]
 
     # both controllers converged to the same replicated model

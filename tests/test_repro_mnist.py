@@ -1,0 +1,55 @@
+"""The BASELINE MNIST+LR reproduction pipeline (exp/repro_mnist_lr.py).
+
+The quick test runs the pipeline end-to-end at 1/10 scale (100 clients) and
+checks the convergence trajectory; the full BASELINE-scale run (1000
+clients, 150 rounds, acc > 75) is the slow-marked test — its committed
+artifacts live in REPRO.md / repro_metrics.jsonl."""
+
+import json
+
+import pytest
+
+from fedml_tpu.data.leaf_fixture import write_leaf_mnist_fixture
+
+
+def test_fixture_is_real_leaf_format(tmp_path):
+    out = write_leaf_mnist_fixture(tmp_path / "leaf", n_clients=12, seed=3)
+    blob = json.loads(next((out / "train").glob("*.json")).read_text())
+    assert set(blob) == {"users", "num_samples", "user_data"}
+    assert len(blob["users"]) == 12
+    u0 = blob["user_data"][blob["users"][0]]
+    assert len(u0["x"][0]) == 784
+    # 2-class clients (the FedProx MNIST partition)
+    assert len(set(u0["y"])) <= 2
+    # idempotent
+    out2 = write_leaf_mnist_fixture(tmp_path / "leaf", n_clients=12, seed=3)
+    assert out2 == out
+
+
+def test_repro_pipeline_converges_small(tmp_path):
+    from fedml_tpu.exp.repro_mnist_lr import main
+
+    result = main([
+        "--client_num_in_total", "100", "--comm_round", "30",
+        "--data_dir", str(tmp_path / "leaf"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    # 1/10-scale trajectory: well past random (10%), climbing toward 75
+    assert result["best_test_acc"] > 0.6, result
+    assert (tmp_path / "R.md").exists()
+    lines = (tmp_path / "m.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 30
+
+
+@pytest.mark.slow
+def test_repro_full_scale(tmp_path):
+    from fedml_tpu.exp.repro_mnist_lr import main
+
+    result = main([
+        "--data_dir", str(tmp_path / "leaf"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    assert result["best_test_acc"] > 0.75, result
+    assert result["first_round_over_75"] is not None
