@@ -43,6 +43,7 @@ class MyMessage:
     MSG_ARG_KEY_MODEL_DESC = "model_desc"
     MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
     MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
 
 
 class FedAvgDistAggregator:
@@ -63,17 +64,25 @@ class FedAvgDistAggregator:
             self.flag_client_model_uploaded_dict[index] = True
             return all(self.flag_client_model_uploaded_dict.values())
 
+    def received_workers(self) -> list[int]:
+        with self._lock:
+            return [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
+
     def aggregate(self) -> np.ndarray:
         # Payloads are pack_pytree byte vectors; model leaves are float32
         # (validated against the descriptor at server init), so the weighted
         # average runs on an f32 view and returns bytes for the wire.
+        # Aggregates whichever workers uploaded this round (all of them in
+        # the synchronous case; the survivors when the elastic round timeout
+        # dropped stragglers) with weights renormalized over that subset.
         with self._lock:
-            w = np.asarray([self.sample_num_dict[i] for i in range(self.worker_num)], np.float64)
+            got = [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
+            w = np.asarray([self.sample_num_dict[i] for i in got], np.float64)
             w = w / w.sum()
-            out = np.zeros(self.model_dict[0].size // 4, dtype=np.float64)
-            for i in range(self.worker_num):
-                out += w[i] * np.ascontiguousarray(self.model_dict[i]).view(np.float32)
-            for i in range(self.worker_num):
+            out = np.zeros(self.model_dict[got[0]].size // 4, dtype=np.float64)
+            for wi, i in zip(w, got):
+                out += wi * np.ascontiguousarray(self.model_dict[i]).view(np.float32)
+            for i in self.flag_client_model_uploaded_dict:
                 self.flag_client_model_uploaded_dict[i] = False
             return out.astype(np.float32).view(np.uint8)
 
@@ -84,6 +93,7 @@ class FedAvgServerManager(ServerManager):
     def __init__(self, comm: BaseCommunicationManager, worker_num: int, round_num: int,
                  init_flat: np.ndarray, model_desc: str,
                  client_num_in_total: int | None = None,
+                 round_timeout: float | None = None,
                  on_round_done: Callable[[int, np.ndarray], None] | None = None):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
@@ -92,6 +102,17 @@ class FedAvgServerManager(ServerManager):
         self.aggregator = FedAvgDistAggregator(worker_num)
         self.global_flat = init_flat
         self.model_desc = model_desc
+        # elastic rounds (SURVEY §5.4 failure handling): if set, a round
+        # closes round_timeout seconds after its first upload even when
+        # stragglers are missing — their weight is renormalized away and
+        # they are marked OFFLINE in ``status`` (reference behavior: a dead
+        # client hangs the round forever, mpi com_manager has no recovery)
+        self.round_timeout = round_timeout
+        from fedml_tpu.comm.status import ClientStatusTracker
+
+        self.status = ClientStatusTracker(worker_num)
+        self._round_timer: "threading.Timer | None" = None
+        self._round_lock = threading.Lock()
         import json
 
         non_f32 = [d["path"] for d in json.loads(model_desc) if d["dtype"] != "float32"]
@@ -118,15 +139,67 @@ class FedAvgServerManager(ServerManager):
 
     def _on_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        from fedml_tpu.comm.status import ClientStatus
+
+        self.status.update(sender, ClientStatus.ONLINE)
+        with self._round_lock:
+            current = self.round_idx
+        upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if upload_round is not None and int(upload_round) != current:
+            # a straggler's upload from a timed-out round: one-round-stale
+            # model, must not pollute the current tally
+            logging.info(
+                "ignoring stale upload from worker %d (round %s, now %d)",
+                sender, upload_round, current,
+            )
+            return
         flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
         all_received = self.aggregator.add_local_trained_result(sender - 1, flat, n)
         if not all_received:
+            if self.round_timeout is not None:
+                with self._round_lock:
+                    if self._round_timer is None and self.round_idx == current:
+                        self._round_timer = threading.Timer(
+                            self.round_timeout, self._round_timed_out, args=(current,)
+                        )
+                        self._round_timer.daemon = True
+                        self._round_timer.start()
             return
-        self.global_flat = self.aggregator.aggregate()
+        self._complete_round(current)
+
+    def _round_timed_out(self, expected_round: int) -> None:
+        with self._round_lock:
+            if self.round_idx != expected_round:
+                return  # the round completed while this timer was in flight
+        got = self.aggregator.received_workers()
+        if not got:
+            return  # nothing to aggregate; keep waiting
+        from fedml_tpu.comm.status import ClientStatus
+
+        missing = sorted(set(range(self.worker_num)) - set(got))
+        for w in missing:
+            self.status.update(w + 1, ClientStatus.OFFLINE)
+        logging.warning(
+            "round %d timed out: aggregating %d/%d workers, dropping %s "
+            "(marked OFFLINE, weights renormalized)",
+            expected_round, len(got), self.worker_num, [w + 1 for w in missing],
+        )
+        self._complete_round(expected_round)
+
+    def _complete_round(self, expected_round: int) -> None:
+        with self._round_lock:
+            if self.round_idx != expected_round:
+                return  # a concurrent close won the race for this round
+            if not self.aggregator.received_workers():
+                return  # benign double fire (timer raced the full tally)
+            if self._round_timer is not None:
+                self._round_timer.cancel()
+                self._round_timer = None
+            self.global_flat = self.aggregator.aggregate()
+            self.round_idx += 1
         if self.on_round_done:
-            self.on_round_done(self.round_idx, self.global_flat)
-        self.round_idx += 1
+            self.on_round_done(expected_round, self.global_flat)
         if self.round_idx >= self.round_num:
             # graceful stop: notify clients then stop own loop (NOT MPI.Abort)
             for w in range(self.worker_num):
@@ -189,6 +262,7 @@ class FedAvgClientManager(ClientManager):
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, flat_out)
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weights[0]))
+        out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round - 1)
         self.send_message(out)
 
 

@@ -181,7 +181,10 @@ class TAServerManager(ServerManager):
     def _close_round(self) -> None:
         with self._lock:
             if not self._share_sums:
-                return  # benign double close (timer raced the full tally)
+                # benign double close (timer raced the full tally); a stale
+                # timer's _timed_out flag must not leak into the next round
+                self._timed_out = False
+                return
             if len(self._share_sums) < self.threshold + 1:
                 logging.error(
                     "turboaggregate round %d: only %d/%d share-sums after "

@@ -50,15 +50,33 @@ class ClientStatusTracker:
     def __init__(self, expected_clients: int):
         self.expected = expected_clients
         self._status: dict[int, str] = {}
+        self._last_seen: dict[int, float] = {}
         self._lock = threading.Lock()
         self._all_online = threading.Event()
 
     def update(self, client_id: int, status: str) -> None:
         with self._lock:
             self._status[client_id] = status
+            self._last_seen[client_id] = time.monotonic()
             online = sum(1 for s in self._status.values() if s == ClientStatus.ONLINE)
             if online >= self.expected:
                 self._all_online.set()
+
+    def stale(self, timeout: float) -> list[int]:
+        """Clients silent for longer than ``timeout`` seconds (and not
+        FINISHED) — candidates for OFFLINE marking / round dropping."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(
+                cid for cid, seen in self._last_seen.items()
+                if now - seen > timeout
+                and self._status.get(cid) not in (ClientStatus.FINISHED,
+                                                  ClientStatus.OFFLINE)
+            )
+
+    def offline_count(self) -> int:
+        with self._lock:
+            return sum(1 for s in self._status.values() if s == ClientStatus.OFFLINE)
 
     def handle_message(self, msg: Message) -> None:
         self.update(msg.get_sender_id(), msg.get(ClientStatus.KEY_STATUS))

@@ -26,15 +26,19 @@ def run(args) -> dict:
 
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data import load_partition_data
-    from fedml_tpu.data.leaf_fixture import write_leaf_mnist_fixture
+    from fedml_tpu.data.leaf_fixture import FIXTURE_MARKER, write_leaf_mnist_fixture
     from fedml_tpu.models.linear import LogisticRegression
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
     data_dir = Path(args.data_dir)
-    real = (data_dir / "train").is_dir() and any((data_dir / "train").glob("*.json"))
-    if not real:
+    real = (
+        (data_dir / "train").is_dir()
+        and any((data_dir / "train").glob("*.json"))
+        and not (data_dir / FIXTURE_MARKER).exists()
+    )
+    if not real and not (data_dir / FIXTURE_MARKER).exists():
         logging.info("no LEAF files at %s — generating offline fixture", data_dir)
         write_leaf_mnist_fixture(data_dir, n_clients=args.client_num_in_total,
                                  seed=args.seed)
@@ -70,6 +74,11 @@ def run(args) -> dict:
     wall = time.time() - t0
 
     evals = [r for r in records if "Test/Acc" in r]
+    if not evals:
+        raise ValueError(
+            f"no eval rounds ran (comm_round={cfg.comm_round} < "
+            f"frequency_of_the_test={cfg.frequency_of_the_test}?)"
+        )
     best = max(e["Test/Acc"] for e in evals)
     first_over_75 = next(
         (e["round"] for e in evals if e["Test/Acc"] > 0.75), None

@@ -58,9 +58,10 @@ def _package_files(src_root: Path):
     for p in sorted((src_root / "fedml_tpu").rglob("*")):
         if p.is_dir():
             continue
-        if any(part in EXCLUDE_DIRS for part in p.parts):
+        rel = p.relative_to(src_root)
+        if any(part in EXCLUDE_DIRS for part in rel.parts):
             continue
-        if p.suffix in (".pyc", ".so.tmp"):
+        if p.name.endswith((".pyc", ".so.tmp")):
             continue
         yield p
 

@@ -1,0 +1,335 @@
+"""Failure handling + previously never-exercised transport code paths.
+
+1. Elastic rounds: a dead client no longer hangs distributed FedAvg forever
+   (the reference's worst behavior) — the server's round timeout aggregates
+   the survivors with renormalized weights and marks the straggler OFFLINE.
+2. The MQTT backend's full logic (topic scheme, subscribe fan-out, last
+   will, status messages, typed wire round-trip) runs against an in-process
+   fake paho broker — no external broker needed.
+3. S3Store runs against a stubbed boto3 client.
+"""
+
+import json
+import sys
+import threading
+import types
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+from fedml_tpu.comm.message import Message
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+
+
+# ---------------------------------------------------------------------------
+# 1. elastic rounds
+# ---------------------------------------------------------------------------
+
+
+class _DeadAfterInitComm(LoopbackCommManager):
+    """Client transport that swallows every upload — the client looks alive
+    at the transport level but its models never arrive."""
+
+    def send_message(self, msg: Message) -> None:
+        return
+
+
+def test_dead_client_does_not_hang_rounds():
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg
+    from fedml_tpu.comm.status import ClientStatus
+
+    train, _ = gaussian_blobs(n_clients=4, samples_per_client=24, num_classes=4, seed=1)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=1
+    )
+    fabric = LoopbackFabric(5)
+    server_holder = {}
+
+    def make_comm(rank):
+        if rank == 3:  # this worker's uploads vanish
+            return _DeadAfterInitComm(fabric, rank)
+        return LoopbackCommManager(fabric, rank)
+
+    # run_distributed_fedavg constructs the server internally; patch in the
+    # round timeout via a wrapper
+    from fedml_tpu.algorithms import fedavg_distributed as fd
+
+    orig = fd.FedAvgServerManager
+
+    class TimeoutServer(orig):
+        def __init__(self, *a, **kw):
+            kw["round_timeout"] = 1.0
+            super().__init__(*a, **kw)
+            server_holder["server"] = self
+
+    fd.FedAvgServerManager, restore = TimeoutServer, orig
+    try:
+        final = fd.run_distributed_fedavg(
+            trainer, train, worker_num=4, round_num=2, batch_size=8,
+            make_comm=make_comm, seed=0,
+        )
+    finally:
+        fd.FedAvgServerManager = restore
+
+    flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(final)])
+    assert np.all(np.isfinite(flat))
+    server = server_holder["server"]
+    assert server.round_idx == 2
+    # the dead worker (rank 3) was detected and marked OFFLINE
+    assert server.status.snapshot().get(3) == ClientStatus.OFFLINE
+
+
+# ---------------------------------------------------------------------------
+# 2. fake paho broker -> real MqttCommManager logic
+# ---------------------------------------------------------------------------
+
+
+class _FakeBroker:
+    """In-process pub/sub hub with last-will semantics."""
+
+    def __init__(self):
+        self.subs: dict[str, list] = {}
+        self.wills: dict[object, tuple] = {}
+        self.lock = threading.Lock()
+
+    def subscribe(self, topic, client):
+        with self.lock:
+            self.subs.setdefault(topic, []).append(client)
+
+    def publish(self, topic, payload):
+        with self.lock:
+            clients = list(self.subs.get(topic, []))
+        for c in clients:
+            m = types.SimpleNamespace(topic=topic, payload=payload)
+            if c.on_message:
+                c.on_message(c, None, m)
+
+    def drop(self, client):
+        """Unclean disconnect -> deliver the will."""
+        will = self.wills.pop(client, None)
+        if will:
+            self.publish(*will)
+
+
+def _install_fake_paho(monkeypatch, broker):
+    class FakeInfo:
+        def wait_for_publish(self):
+            pass
+
+    class FakeClient:
+        def __init__(self, *a, client_id="", protocol=None, **kw):
+            self.client_id = client_id
+            self.on_connect = None
+            self.on_message = None
+            self._broker = broker
+
+        def will_set(self, topic, payload, qos=0, retain=False):
+            broker.wills[self] = (topic, payload)
+
+        def connect(self, host, port, keepalive=60):
+            pass
+
+        def loop_start(self):
+            if self.on_connect:
+                self.on_connect(self, None, None, 0)
+
+        def subscribe(self, topic, qos=0):
+            broker.subscribe(topic, self)
+
+        def publish(self, topic, payload, qos=0, retain=False):
+            broker.publish(topic, payload)
+            return FakeInfo()
+
+        def loop_stop(self):
+            pass
+
+        def disconnect(self):
+            broker.wills.pop(self, None)  # clean disconnect: no will
+
+    fake_mqtt = types.ModuleType("paho.mqtt.client")
+    fake_mqtt.Client = FakeClient
+    fake_mqtt.MQTTv311 = 4
+    fake_paho = types.ModuleType("paho")
+    fake_paho_mqtt = types.ModuleType("paho.mqtt")
+    monkeypatch.setitem(sys.modules, "paho", fake_paho)
+    monkeypatch.setitem(sys.modules, "paho.mqtt", fake_paho_mqtt)
+    monkeypatch.setitem(sys.modules, "paho.mqtt.client", fake_mqtt)
+    return fake_mqtt
+
+
+def test_mqtt_backend_roundtrip_on_fake_broker(monkeypatch):
+    broker = _FakeBroker()
+    _install_fake_paho(monkeypatch, broker)
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+    status_log = []
+    server = MqttCommManager("localhost", 1883, topic="job", client_id=0, client_num=2)
+    c1 = MqttCommManager("localhost", 1883, topic="job", client_id=1)
+    c2 = MqttCommManager("localhost", 1883, topic="job", client_id=2)
+
+    # observe the status topic like comm.status would
+    class _StatusTap:
+        on_message = None
+
+    tap = _StatusTap()
+    tap.on_message = lambda c, u, m: status_log.append(json.loads(m.payload))
+    broker.subscribe("job/status", tap)
+
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append((t, m))
+
+    server.add_observer(Obs())
+
+    # client 1 -> server with a typed array payload
+    msg = Message(42, 1, 0)
+    msg.add_params("weights", np.arange(6, dtype=np.float32).reshape(2, 3))
+    c1.send_message(msg)
+    t = threading.Thread(target=server.handle_receive_message, daemon=True)
+    t.start()
+    import time
+
+    for _ in range(50):
+        if got:
+            break
+        time.sleep(0.05)
+    server.stop_receive_message()
+    t.join(timeout=5)
+    assert got and got[0][0] == 42
+    np.testing.assert_array_equal(
+        got[0][1].get("weights"), np.arange(6, dtype=np.float32).reshape(2, 3)
+    )
+
+    # server -> client 2 topic scheme (0_2)
+    got2 = []
+
+    class Obs2:
+        def receive_message(self, t, m):
+            got2.append(t)
+
+    c2.add_observer(Obs2())
+    out = Message(7, 0, 2)
+    out.add_params("x", 1)
+    server.send_message(out)
+    t2 = threading.Thread(target=c2.handle_receive_message, daemon=True)
+    t2.start()
+    for _ in range(50):
+        if got2:
+            break
+        time.sleep(0.05)
+    c2.stop_receive_message()
+    t2.join(timeout=5)
+    assert got2 == [7]
+
+    # last-will: dropping client 1 uncleanly publishes OFFLINE
+    broker.drop(c1.client)
+    assert {"id": 1, "status": "OFFLINE"} in status_log
+    # clean shutdowns published ONLINE earlier and FINISHED on stop
+    statuses = [(s["id"], s["status"]) for s in status_log]
+    assert (0, "FINISHED") in statuses or (2, "FINISHED") in statuses
+
+
+# ---------------------------------------------------------------------------
+# 3. stubbed boto3 -> real S3Store logic
+# ---------------------------------------------------------------------------
+
+
+def test_s3_store_with_stub_boto3(monkeypatch):
+    blobs = {}
+
+    class FakeS3Client:
+        def put_object(self, Bucket, Key, Body):
+            blobs[(Bucket, Key)] = bytes(Body)
+
+        def get_object(self, Bucket, Key):
+            import io
+
+            return {"Body": io.BytesIO(blobs[(Bucket, Key)])}
+
+        def delete_object(self, Bucket, Key):
+            blobs.pop((Bucket, Key), None)
+
+    fake_boto3 = types.ModuleType("boto3")
+    fake_boto3.client = lambda service, **kw: FakeS3Client()
+    monkeypatch.setitem(sys.modules, "boto3", fake_boto3)
+
+    from fedml_tpu.comm.object_store import S3Store
+
+    store = S3Store("bucket", prefix="pfx")
+    store.put("k1", b"hello world")
+    assert store.get("k1") == b"hello world"
+    assert ("bucket", "pfx/k1") in blobs
+    store.delete("k1")
+    assert not blobs
+
+
+class _SlowComm(LoopbackCommManager):
+    """Client transport that delays every upload past the round timeout —
+    the stale uploads must be rejected by their round stamp, not averaged
+    into later rounds."""
+
+    def send_message(self, msg: Message) -> None:
+        from fedml_tpu.algorithms.fedavg_distributed import MyMessage
+
+        if msg.get_type() == MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER:
+            def later():
+                import time
+
+                time.sleep(2.5)
+                super(_SlowComm, self).send_message(msg)
+
+            threading.Thread(target=later, daemon=True).start()
+            return
+        super().send_message(msg)
+
+
+def test_slow_straggler_uploads_are_rejected_not_mixed():
+    from fedml_tpu.algorithms import fedavg_distributed as fd
+
+    train, _ = gaussian_blobs(n_clients=3, samples_per_client=24, num_classes=4, seed=2)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=1
+    )
+    fabric = LoopbackFabric(4)
+    stale_log = []
+
+    orig = fd.FedAvgServerManager
+
+    class TimeoutServer(orig):
+        def __init__(self, *a, **kw):
+            kw["round_timeout"] = 1.0
+            super().__init__(*a, **kw)
+
+        def _on_model_from_client(self, msg):
+            r = msg.get(fd.MyMessage.MSG_ARG_KEY_ROUND_IDX)
+            with self._round_lock:
+                if r is not None and int(r) != self.round_idx:
+                    stale_log.append((msg.get_sender_id(), int(r), self.round_idx))
+            super()._on_model_from_client(msg)
+
+    def make_comm(rank):
+        if rank == 2:
+            return _SlowComm(fabric, rank)
+        return LoopbackCommManager(fabric, rank)
+
+    fd.FedAvgServerManager = TimeoutServer
+    try:
+        final = fd.run_distributed_fedavg(
+            trainer, train, worker_num=3, round_num=3, batch_size=8,
+            make_comm=make_comm, seed=0,
+        )
+    finally:
+        fd.FedAvgServerManager = orig
+    flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(final)])
+    assert np.all(np.isfinite(flat))
+    # at least one of the slow worker's late round-r uploads arrived when the
+    # server had already advanced — and was rejected rather than averaged in
+    assert any(sender == 2 and sent_r < cur for sender, sent_r, cur in stale_log), stale_log
