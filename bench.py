@@ -79,23 +79,33 @@ def lm_train_flops_per_round() -> float:
     return 3.0 * fwd_per_tok * tokens
 
 
-def _measure_rounds(sim, n_meas: int = 5) -> float:
+def _measure_rounds(sim, n_meas: int = 5, block: int = 1) -> float:
     """Seconds per round, steady state. Forces a host fetch of the round's
-    aggregated train loss so remote-async dispatch can't fake the timing."""
-    import jax
-
+    aggregated train loss so remote-async dispatch can't fake the timing.
+    ``block`` > 1 measures the block-dispatch path (R rounds per device
+    round-trip — the deployment configuration for small models)."""
     from fedml_tpu.core import rng as rnglib
 
     variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     root = rnglib.root_key(0)
-    variables, server_state, m = sim.run_round(0, variables, server_state, root)
-    float(m["Train/Loss"])  # compile + first-round sync
+    if block == 1:
+        variables, server_state, m = sim.run_round(0, variables, server_state, root)
+        float(m["Train/Loss"])  # compile + first-round sync
+        t0 = time.perf_counter()
+        for r in range(1, 1 + n_meas):
+            variables, server_state, m = sim.run_round(r, variables, server_state, root)
+            float(m["Train/Loss"])
+        return (time.perf_counter() - t0) / n_meas
+    variables, server_state, m = sim.run_block(0, block, variables, server_state, root)
+    float(m["Train/Loss"][-1])  # compile + first-block sync
     t0 = time.perf_counter()
-    for r in range(1, 1 + n_meas):
-        variables, server_state, m = sim.run_round(r, variables, server_state, root)
-        float(m["Train/Loss"])
-    return (time.perf_counter() - t0) / n_meas
+    for i in range(n_meas):
+        variables, server_state, m = sim.run_block(
+            (i + 1) * block, block, variables, server_state, root
+        )
+        float(m["Train/Loss"][-1])
+    return (time.perf_counter() - t0) / (n_meas * block)
 
 
 def bench_resnet():
@@ -133,7 +143,12 @@ def bench_resnet():
         "y": rng.randint(0, 10, n_eval).astype(np.int32),
     }
     sim = FedSim(trainer, train, test, cfg)
-    sec_per_round = _measure_rounds(sim)
+    # block dispatch (10 rounds per device round-trip): how the engine
+    # actually runs between eval points
+    sec_per_round = _measure_rounds(sim, n_meas=3, block=10)
+    sec_per_round_single = _measure_rounds(
+        FedSim(trainer, train, test, cfg), n_meas=5, block=1
+    )
 
     # pooled eval throughput (examples/sec): evaluate() runs the pooled train
     # set (n) plus the test set (n_eval) and returns host floats, so it is
@@ -145,7 +160,7 @@ def bench_resnet():
     for _ in range(n_meas):
         sim.evaluate(variables)
     eval_eps = (n + n_eval) * n_meas / (time.perf_counter() - t0)
-    return 1.0 / sec_per_round, eval_eps
+    return 1.0 / sec_per_round, 1.0 / sec_per_round_single, eval_eps
 
 
 def bench_lm():
@@ -268,7 +283,7 @@ def main():
     device_kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(device_kind)
 
-    rounds_per_sec, eval_eps = bench_resnet()
+    rounds_per_sec, rounds_per_sec_single, eval_eps = bench_resnet()
     resnet_tflops = (
         resnet56_train_flops_per_image() * CLIENTS * STEPS * BATCH * EPOCHS
         * rounds_per_sec / 1e12
@@ -294,6 +309,7 @@ def main():
             "lm_sec_per_round": round(lm_sec, 4),
             "lm_delivered_tflops": round(lm_tflops, 2),
             "resnet_delivered_tflops": round(resnet_tflops, 2),
+            "resnet_rounds_per_sec_single_dispatch": round(rounds_per_sec_single, 3),
             "eval_examples_per_sec": round(eval_eps, 1),
         },
     }))

@@ -142,31 +142,34 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.comm.status import ClientStatus
 
         self.status.update(sender, ClientStatus.ONLINE)
-        with self._round_lock:
-            current = self.round_idx
-        upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-        if upload_round is not None and int(upload_round) != current:
-            # a straggler's upload from a timed-out round: one-round-stale
-            # model, must not pollute the current tally
-            logging.info(
-                "ignoring stale upload from worker %d (round %s, now %d)",
-                sender, upload_round, current,
-            )
-            return
         flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
-        all_received = self.aggregator.add_local_trained_result(sender - 1, flat, n)
-        if not all_received:
-            if self.round_timeout is not None:
-                with self._round_lock:
-                    if self._round_timer is None and self.round_idx == current:
-                        self._round_timer = threading.Timer(
-                            self.round_timeout, self._round_timed_out, args=(current,)
-                        )
-                        self._round_timer.daemon = True
-                        self._round_timer.start()
-            return
-        self._complete_round(current)
+        upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        # staleness check and tally are one critical section: a timer closing
+        # the round between them would otherwise let a round-r model slip
+        # into round r+1's tally
+        with self._round_lock:
+            current = self.round_idx
+            if upload_round is not None and int(upload_round) != current:
+                # a straggler's upload from a timed-out round: one-round-stale
+                # model, must not pollute the current tally
+                logging.info(
+                    "ignoring stale upload from worker %d (round %s, now %d)",
+                    sender, upload_round, current,
+                )
+                return
+            all_received = self.aggregator.add_local_trained_result(
+                sender - 1, flat, n
+            )
+            if not all_received and self.round_timeout is not None:
+                if self._round_timer is None:
+                    self._round_timer = threading.Timer(
+                        self.round_timeout, self._round_timed_out, args=(current,)
+                    )
+                    self._round_timer.daemon = True
+                    self._round_timer.start()
+        if all_received:
+            self._complete_round(current)
 
     def _round_timed_out(self, expected_round: int) -> None:
         with self._round_lock:
@@ -303,6 +306,7 @@ def run_distributed_fedavg(
     batch_size: int,
     make_comm: Callable[[int], BaseCommunicationManager],
     seed: int = 0,
+    round_timeout: float | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
@@ -323,6 +327,7 @@ def run_distributed_fedavg(
     server = FedAvgServerManager(
         make_comm(0), worker_num, round_num, flat, desc,
         client_num_in_total=train_data.num_clients,
+        round_timeout=round_timeout,
         on_round_done=_done,
     )
     clients = [

@@ -74,9 +74,6 @@ class ClientStatusTracker:
                                                   ClientStatus.OFFLINE)
             )
 
-    def offline_count(self) -> int:
-        with self._lock:
-            return sum(1 for s in self._status.values() if s == ClientStatus.OFFLINE)
 
     def handle_message(self, msg: Message) -> None:
         self.update(msg.get_sender_id(), msg.get(ClientStatus.KEY_STATUS))

@@ -38,7 +38,7 @@ def run(args) -> dict:
         and any((data_dir / "train").glob("*.json"))
         and not (data_dir / FIXTURE_MARKER).exists()
     )
-    if not real and not (data_dir / FIXTURE_MARKER).exists():
+    if not real:
         logging.info("no LEAF files at %s — generating offline fixture", data_dir)
         write_leaf_mnist_fixture(data_dir, n_clients=args.client_num_in_total,
                                  seed=args.seed)

@@ -325,6 +325,75 @@ class FedSim:
             global_variables, server_state, batches, weights, num_steps, rng
         )
 
+    def _block_impl(self, global_variables, server_state, dataset, idxs,
+                    weights, num_steps, rngs):
+        # R stacked rounds in one program: lax.scan over the round axis of
+        # [R, C_local, ...] index/weight stacks. One dispatch per block
+        # amortizes host->device latency over R rounds (the per-round
+        # dispatch cost dominates small models on remote-attached chips).
+        def step(carry, xs):
+            v, s = carry
+            idx, w, ns, key = xs
+            v, s, m = self._gather_round_impl(v, s, dataset, idx, w, ns, key)
+            return (v, s), m
+
+        (v, s), ms = jax.lax.scan(
+            step, (global_variables, server_state),
+            (idxs, weights, num_steps, rngs),
+        )
+        return v, s, ms
+
+    def _get_block_fn(self, n_rounds: int):
+        """Compiled R-round block program (cached per R)."""
+        from jax.sharding import PartitionSpec as P
+
+        if not hasattr(self, "_block_fns"):
+            self._block_fns = {}
+        if n_rounds not in self._block_fns:
+            cohort_spec = P(None, meshlib.CLIENT_AXIS)
+            var_spec = (
+                P(meshlib.CLIENT_AXIS) if self._per_client else P()
+            )
+            self._block_fns[n_rounds] = jax.jit(
+                jax.shard_map(
+                    self._block_impl,
+                    mesh=self.mesh,
+                    in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
+                              cohort_spec, P()),
+                    out_specs=(var_spec, P(), P()),
+                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                    check_vma=False,
+                ),
+                donate_argnums=(0,),
+            )
+        return self._block_fns[n_rounds]
+
+    def run_block(self, start_round: int, n_rounds: int, global_variables,
+                  server_state, root_rng):
+        """Run ``n_rounds`` consecutive rounds in ONE device dispatch
+        (on-device-dataset path only). Returns (variables, server_state,
+        stacked metrics dict with a leading [n_rounds] axis)."""
+        if not self._on_device:
+            raise ValueError("run_block requires the on-device dataset path")
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        per_round = [
+            self._host_cohort_indices(self._sample_round_cohort(r), r)
+            for r in range(start_round, start_round + n_rounds)
+        ]
+        block_sharding = NamedSharding(self.mesh, P(None, meshlib.CLIENT_AXIS))
+        idxs = self._put(np.stack([p[0] for p in per_round]), block_sharding)
+        weights = self._put(np.stack([p[1] for p in per_round]), block_sharding)
+        num_steps = self._put(np.stack([p[2] for p in per_round]), block_sharding)
+        rngs = jnp.stack([
+            rnglib.round_key(root_rng, r)
+            for r in range(start_round, start_round + n_rounds)
+        ])
+        return self._get_block_fn(n_rounds)(
+            global_variables, server_state, self._dataset, idxs, weights,
+            num_steps, rngs,
+        )
+
     def _eval_impl(self, variables, batches):
         def step(carry, batch):
             return carry, self.trainer.eval_batch(variables, batch)
@@ -434,10 +503,9 @@ class FedSim:
             epochs_arr = np.full(len(cohort), cfg.epochs, np.int32)
         return (epochs_arr * self._steps).astype(np.int32)
 
-    def stage_cohort_indices(self, cohort, round_idx: int):
-        """Device staging for the on-device-dataset path: instead of the full
-        [C, S, B, ...] batch stack, upload only a [C, S, B] int32 index map
-        (-1 = empty slot); the round program gathers rows in HBM."""
+    def _host_cohort_indices(self, cohort, round_idx: int):
+        """Host-side index staging: [C_pad, S, B] int32 index map (-1 = empty
+        slot) + weights + per-client step budgets, padded to the mesh."""
         cfg = self.config
         slots = self._steps * cfg.batch_size
         shuffle = (
@@ -462,11 +530,19 @@ class FedSim:
             idx = np.concatenate([idx, np.full((pad, slots), -1, np.int32)])
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
+        return idx.reshape(-1, self._steps, cfg.batch_size), weights, num_steps
+
+    def stage_cohort_indices(self, cohort, round_idx: int):
+        """Device staging for the on-device-dataset path: instead of the full
+        [C, S, B, ...] batch stack, upload only a [C, S, B] int32 index map
+        (-1 = empty slot); the round program gathers rows in HBM."""
+        idx, weights, num_steps = self._host_cohort_indices(cohort, round_idx)
         sharded = meshlib.client_sharded(self.mesh)
-        idx = self._put(idx.reshape(-1, self._steps, cfg.batch_size), sharded)
-        weights = self._put(weights, sharded)
-        num_steps = self._put(num_steps, sharded)
-        return idx, weights, num_steps
+        return (
+            self._put(idx, sharded),
+            self._put(weights, sharded),
+            self._put(num_steps, sharded),
+        )
 
     def _sample_round_cohort(self, round_idx: int) -> np.ndarray:
         cfg = self.config
@@ -580,29 +656,63 @@ class FedSim:
         root = rnglib.root_key(cfg.seed)
         history = []
         profiling = False
+        # Dispatch rounds in blocks aligned to eval boundaries (one device
+        # dispatch per block amortizes host->device latency; alignment keeps
+        # every eval at a block end so accuracy is attributed to the right
+        # round); single-round blocks when the dataset is host-staged.
+        freq = max(cfg.frequency_of_the_test, 1)
         try:
-            for r in range(cfg.comm_round):
-                # start the trace at round 1 so compilation (round 0) doesn't
-                # drown the steady-state rounds in the profile
-                if cfg.profile_dir and not profiling and r == min(1, cfg.comm_round - 1):
+            r = 0
+            while r < cfg.comm_round:
+                # start the trace after round 0 so compilation doesn't drown
+                # the steady-state rounds in the profile (a 1-round run
+                # traces its only round, compilation included)
+                if cfg.profile_dir and not profiling and (
+                    r > 0 or cfg.comm_round == 1
+                ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
+                next_eval = ((r // freq) + 1) * freq
+                n = min(cfg.comm_round, next_eval) - r if self._on_device else 1
+                # round 0 runs alone so the trace/profile skips compilation
+                if cfg.profile_dir and r == 0:
+                    n = 1
                 t0 = time.perf_counter()
-                variables, server_state, metrics = self.run_round(
-                    r, variables, server_state, root
-                )
-                jax.block_until_ready(variables)
-                rec = {"round": r, "round_time": time.perf_counter() - t0}
-                rec.update({k: float(v) for k, v in metrics.items()})
-                if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-                    eval_vars = self.consensus(variables)
-                    rec.update(self.evaluate(eval_vars))
-                    if cfg.eval_on_clients:
-                        rec.update(self.per_client_summary(eval_vars))
-                history.append(rec)
-                if callback:
-                    callback(rec)
-                logging.info("round %d: %s", r, {k: v for k, v in rec.items() if k != "round"})
+                if n == 1:
+                    variables, server_state, metrics = self.run_round(
+                        r, variables, server_state, root
+                    )
+                    stacked = {k: jnp.asarray(v)[None] for k, v in metrics.items()}
+                else:
+                    variables, server_state, stacked = self.run_block(
+                        r, n, variables, server_state, root
+                    )
+                stacked = {k: np.asarray(v) for k, v in stacked.items()}
+                block_time = None
+                for j in range(n):
+                    rr = r + j
+                    if block_time is None and j == n - 1:
+                        jax.block_until_ready(variables)
+                        block_time = time.perf_counter() - t0
+                    rec = {
+                        "round": rr,
+                        "round_time": (block_time / n) if j == n - 1 else None,
+                    }
+                    rec.update({k: float(v[j]) for k, v in stacked.items()})
+                    if (rr + 1) % cfg.frequency_of_the_test == 0 or rr == cfg.comm_round - 1:
+                        eval_vars = self.consensus(variables)
+                        rec.update(self.evaluate(eval_vars))
+                        if cfg.eval_on_clients:
+                            rec.update(self.per_client_summary(eval_vars))
+                    rec = {k: v for k, v in rec.items() if v is not None}
+                    history.append(rec)
+                    if callback:
+                        callback(rec)
+                    logging.info(
+                        "round %d: %s", rr,
+                        {k: v for k, v in rec.items() if k != "round"},
+                    )
+                r += n
         finally:
             if profiling:
                 jax.profiler.stop_trace()

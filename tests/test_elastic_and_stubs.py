@@ -56,23 +56,20 @@ def test_dead_client_does_not_hang_rounds():
             return _DeadAfterInitComm(fabric, rank)
         return LoopbackCommManager(fabric, rank)
 
-    # run_distributed_fedavg constructs the server internally; patch in the
-    # round timeout via a wrapper
     from fedml_tpu.algorithms import fedavg_distributed as fd
 
     orig = fd.FedAvgServerManager
 
-    class TimeoutServer(orig):
+    class CapturingServer(orig):
         def __init__(self, *a, **kw):
-            kw["round_timeout"] = 1.0
             super().__init__(*a, **kw)
             server_holder["server"] = self
 
-    fd.FedAvgServerManager, restore = TimeoutServer, orig
+    fd.FedAvgServerManager, restore = CapturingServer, orig
     try:
         final = fd.run_distributed_fedavg(
             trainer, train, worker_num=4, round_num=2, batch_size=8,
-            make_comm=make_comm, seed=0,
+            make_comm=make_comm, seed=0, round_timeout=1.0,
         )
     finally:
         fd.FedAvgServerManager = restore
@@ -303,11 +300,7 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
 
     orig = fd.FedAvgServerManager
 
-    class TimeoutServer(orig):
-        def __init__(self, *a, **kw):
-            kw["round_timeout"] = 1.0
-            super().__init__(*a, **kw)
-
+    class StaleLogServer(orig):
         def _on_model_from_client(self, msg):
             r = msg.get(fd.MyMessage.MSG_ARG_KEY_ROUND_IDX)
             with self._round_lock:
@@ -320,11 +313,11 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
             return _SlowComm(fabric, rank)
         return LoopbackCommManager(fabric, rank)
 
-    fd.FedAvgServerManager = TimeoutServer
+    fd.FedAvgServerManager = StaleLogServer
     try:
         final = fd.run_distributed_fedavg(
             trainer, train, worker_num=3, round_num=3, batch_size=8,
-            make_comm=make_comm, seed=0,
+            make_comm=make_comm, seed=0, round_timeout=1.0,
         )
     finally:
         fd.FedAvgServerManager = orig
@@ -333,3 +326,19 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
     # at least one of the slow worker's late round-r uploads arrived when the
     # server had already advanced — and was rejected rather than averaged in
     assert any(sender == 2 and sent_r < cur for sender, sent_r, cur in stale_log), stale_log
+
+
+def test_status_tracker_stale_detection():
+    import time
+
+    from fedml_tpu.comm.status import ClientStatus, ClientStatusTracker
+
+    t = ClientStatusTracker(expected_clients=3)
+    t.update(1, ClientStatus.ONLINE)
+    t.update(2, ClientStatus.ONLINE)
+    t.update(3, ClientStatus.ONLINE)
+    time.sleep(0.15)
+    t.update(2, ClientStatus.ONLINE)  # heartbeat
+    t.update(3, ClientStatus.OFFLINE)  # already marked: excluded from stale()
+    assert t.stale(0.1) == [1]
+    assert t.stale(10.0) == []
