@@ -38,31 +38,35 @@ class FedNASTrainer:
     def init(self, rng: jax.Array, sample_x: jnp.ndarray) -> Pytree:
         return dict(self.network.init({"params": rng}, sample_x, train=False))
 
-    def _loss(self, params, arch, state, batch):
+    def _loss(self, params, arch, state, batch, rng):
         out, new_state = self.network.apply(
             {"params": params, "arch": arch, **state}, batch["x"], train=True,
             mutable=[k for k in list(state.keys()) + []] or ["batch_stats"],
+            rngs={"gumbel": rng},  # used only by search_mode="gdas"
         )
         ce = optax.softmax_cross_entropy_with_integer_labels(out, batch["y"])
         m = batch["mask"]
         return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0), new_state
 
-    def search_step(self, variables: Pytree, opt_states, train_batch, val_batch):
+    def search_step(self, variables: Pytree, opt_states, train_batch, val_batch,
+                    rng=None):
         """One bilevel alternation (FedNASTrainer.local_search:82-127)."""
+        rng = rng if rng is not None else jax.random.key(0)
+        a_rng, w_rng = jax.random.split(rng)
         params, arch = variables["params"], variables["arch"]
         state = {k: v for k, v in variables.items() if k not in ("params", "arch")}
         w_opt_state, a_opt_state = opt_states
 
         # α step on validation loss (architect.step, first-order)
         (val_loss, _), a_grads = jax.value_and_grad(
-            lambda a: self._loss(params, a, state, val_batch), has_aux=True
+            lambda a: self._loss(params, a, state, val_batch, a_rng), has_aux=True
         )(arch)
         a_updates, a_opt_state = self.arch_opt.update(a_grads, a_opt_state, arch)
         arch = optax.apply_updates(arch, a_updates)
 
         # weight step on training loss
         (train_loss, new_state), w_grads = jax.value_and_grad(
-            lambda p: self._loss(p, arch, state, train_batch), has_aux=True
+            lambda p: self._loss(p, arch, state, train_batch, w_rng), has_aux=True
         )(params)
         w_updates, w_opt_state = self.w_opt.update(w_grads, w_opt_state, params)
         params = optax.apply_updates(params, w_updates)
@@ -82,21 +86,24 @@ class FedNASTrainer:
         )
 
         def epoch(carry, _):
-            variables, opt_states = carry
+            variables, opt_states, rng_e = carry
 
             def step(carry, inp):
-                variables, opt_states = carry
+                variables, opt_states, rng_s = carry
                 tb, vb = inp
-                variables, opt_states, losses = self.search_step(variables, opt_states, tb, vb)
-                return (variables, opt_states), losses["train_loss"]
+                rng_s, step_rng = jax.random.split(rng_s)
+                variables, opt_states, losses = self.search_step(
+                    variables, opt_states, tb, vb, step_rng
+                )
+                return (variables, opt_states, rng_s), losses["train_loss"]
 
-            (variables, opt_states), losses = jax.lax.scan(
-                step, (variables, opt_states), (train_batches, val_batches)
+            (variables, opt_states, rng_e), losses = jax.lax.scan(
+                step, (variables, opt_states, rng_e), (train_batches, val_batches)
             )
-            return (variables, opt_states), losses.mean()
+            return (variables, opt_states, rng_e), losses.mean()
 
-        (variables, _), epoch_losses = jax.lax.scan(
-            epoch, (global_variables, opt_states), None, length=self.epochs
+        (variables, _, _), epoch_losses = jax.lax.scan(
+            epoch, (global_variables, opt_states, rng), None, length=self.epochs
         )
         return variables, {"train_loss": epoch_losses[-1]}
 

@@ -56,3 +56,25 @@ class CNNDropOut(nn.Module):
         x = nn.relu(nn.Dense(128)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
         return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+class LeNet(nn.Module):
+    """LeNet-5 for the mobile client family (reference
+    fedml_api/model/mobile/torch_lenet.py LeNet and its MNN twin
+    mnn_lenet.py — conv 1->20 5x5, conv 20->50 5x5, fc 800->500, fc 500->10,
+    max-pool after each conv). The on-device exchange format for this model
+    is the aligned flat weight list (fedml_tpu/models/export.py)."""
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(20, (5, 5), padding="VALID")(_ensure_nhwc(x))
+        h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.relu(h)
+        h = nn.Conv(50, (5, 5), padding="VALID")(h)
+        h = nn.max_pool(h, (2, 2), strides=(2, 2))
+        h = nn.relu(h)
+        h = h.reshape((h.shape[0], -1))
+        h = nn.relu(nn.Dense(500)(h))
+        return nn.Dense(self.num_classes)(h)

@@ -83,14 +83,16 @@ class Cell(nn.Module):
     reduction: bool = False
 
     @nn.compact
-    def __call__(self, s0, s1, alphas, train: bool = False):
+    def __call__(self, s0, s1, weights, train: bool = False):
+        # ``weights`` [E, |PRIMITIVES|] are already normalized edge weights:
+        # softmax(alpha) for DARTS, a straight-through Gumbel one-hot for
+        # GDAS (model_search_gdas.py:122-133)
         s0 = nn.Conv(self.channels, (1, 1), use_bias=False)(nn.relu(s0))
         if s1.shape[1] != s0.shape[1]:  # previous cell reduced
             s0 = nn.avg_pool(s0, (2, 2), strides=(2, 2))
         s1 = nn.Conv(self.channels, (1, 1), use_bias=False)(nn.relu(s1))
         states = [s0, s1]
         offset = 0
-        weights = jax.nn.softmax(alphas, axis=-1)
         for i in range(self.steps):
             acc = None
             for j, h in enumerate(states):
@@ -106,15 +108,36 @@ def num_edges(steps: int) -> int:
     return sum(2 + i for i in range(steps))
 
 
+def gumbel_hard_weights(alphas, rng, tau: float):
+    """Straight-through Gumbel-softmax over the op axis (torch
+    F.gumbel_softmax(alphas, tau, hard=True), model_search_gdas.py:127-129):
+    hard one-hot forward, soft gradient."""
+    g = jax.random.gumbel(rng, alphas.shape)
+    soft = jax.nn.softmax((alphas + g) / tau, axis=-1)
+    hard = jax.nn.one_hot(jnp.argmax(soft, axis=-1), alphas.shape[-1])
+    return hard + soft - jax.lax.stop_gradient(soft)
+
+
 class DARTSNetwork(nn.Module):
     """Searchable network (model_search.py Network): stem → cells → classifier.
     α lives in the ``arch`` collection: ``arch/alphas_normal`` and
-    ``arch/alphas_reduce`` [E, |PRIMITIVES|]."""
+    ``arch/alphas_reduce`` [E, |PRIMITIVES|].
+
+    ``search_mode="gdas"`` switches to the Gumbel-softmax variant
+    (model_search_gdas.py Network_GumbelSoftmax): each forward draws ONE
+    hard op selection per edge (straight-through gradient, temperature
+    ``tau``), shared by all cells of the same type, exactly like the
+    reference's per-forward F.gumbel_softmax. All branches still execute
+    densely and the one-hot selects — on TPU the dense batched form keeps
+    the MXU busy, whereas per-edge lax.switch would serialize tiny kernels.
+    Training needs a ``gumbel`` rng stream; eval uses the argmax ops."""
 
     num_classes: int = 10
     channels: int = 8
     layers: int = 4
     steps: int = 3
+    search_mode: str = "darts"  # darts | gdas
+    tau: float = 5.0  # gdas temperature (reference sets 5, annealed outside)
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -123,6 +146,23 @@ class DARTSNetwork(nn.Module):
                             lambda: 1e-3 * jax.random.normal(self.make_rng("params"), (E, len(PRIMITIVES))))
         a_r = self.variable("arch", "alphas_reduce",
                             lambda: 1e-3 * jax.random.normal(self.make_rng("params"), (E, len(PRIMITIVES))))
+
+        def edge_weights(alphas):
+            if self.search_mode == "gdas":
+                if train:
+                    return gumbel_hard_weights(
+                        alphas, self.make_rng("gumbel"), self.tau
+                    )
+                return jax.nn.one_hot(
+                    jnp.argmax(alphas, axis=-1), alphas.shape[-1]
+                )
+            return jax.nn.softmax(alphas, axis=-1)
+
+        # one sample per forward, shared across same-type cells (the
+        # reference draws per cell-visit, but alphas are shared, so one draw
+        # per type is the faithful single-sample semantics and cheaper)
+        w_n = edge_weights(a_n.value)
+        w_r = edge_weights(a_r.value)
         h = nn.Conv(self.channels * 3, (3, 3), padding="SAME", use_bias=False)(x.astype(jnp.float32))
         h = nn.BatchNorm(use_running_average=not train)(h)
         s0 = s1 = h
@@ -132,7 +172,7 @@ class DARTSNetwork(nn.Module):
             if reduction:
                 c *= 2
             cell = Cell(c, self.steps, reduction)
-            s0, s1 = s1, cell(s0, s1, a_r.value if reduction else a_n.value, train=train)
+            s0, s1 = s1, cell(s0, s1, w_r if reduction else w_n, train=train)
         out = jnp.mean(s1, axis=(1, 2))
         return nn.Dense(self.num_classes)(out)
 

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg
+from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg, LeNet
 from fedml_tpu.models.gan import Discriminator, Generator
 from fedml_tpu.models.linear import LogisticRegression
 from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
@@ -30,6 +30,8 @@ def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
         return RNNOriginalFedAvg()
     if model_name == "cnn":  # femnist
         return CNNDropOut(num_classes=output_dim)
+    if model_name == "lenet":  # mobile family (reference torch_lenet.py)
+        return LeNet(num_classes=output_dim)
     if model_name == "cnn_original":
         return CNNOriginalFedAvg(num_classes=output_dim)
     if model_name == "resnet18_gn":

@@ -184,3 +184,43 @@ def test_efficientnet_registry():
     x = jnp.ones((1, 32, 32, 3))
     out = m.apply(m.init({"params": KEY, "dropout": KEY}, x, train=False), x, train=False)
     assert out.shape == (1, 10)
+
+
+def test_lenet_shapes():
+    from fedml_tpu.models.cnn import LeNet
+
+    m = LeNet(num_classes=10)
+    x = jnp.ones((2, 28, 28, 1))
+    v = m.init({"params": jax.random.key(0)}, x)
+    assert m.apply(v, x).shape == (2, 10)
+    # 3-dim (H, W) input is auto-expanded (LEAF mnist arrays)
+    assert m.apply(v, jnp.ones((2, 28, 28))).shape == (2, 10)
+
+
+def test_darts_gdas_samples_single_op():
+    import numpy as np
+
+    from fedml_tpu.models.darts import DARTSNetwork, gumbel_hard_weights
+
+    # straight-through weights: exact one-hot forward, soft gradient
+    alphas = jnp.asarray(np.random.RandomState(0).randn(5, 6).astype(np.float32))
+    w = gumbel_hard_weights(alphas, jax.random.key(1), tau=5.0)
+    np.testing.assert_allclose(np.asarray(w.sum(-1)), np.ones(5), rtol=1e-6)
+    # one-hot up to float rounding ((1 + s) - s): one ~1.0 entry per edge
+    wn = np.asarray(w)
+    assert (np.isclose(wn, 1.0, atol=1e-5).sum(axis=-1) == 1).all()
+    assert np.allclose(np.sort(wn, axis=-1)[:, :-1], 0.0, atol=1e-5)
+    g = jax.grad(lambda a: gumbel_hard_weights(a, jax.random.key(1), 5.0).sum())(alphas)
+    assert np.isfinite(np.asarray(g)).all()
+
+    net = DARTSNetwork(num_classes=4, channels=4, layers=2, steps=2,
+                       search_mode="gdas")
+    x = jnp.ones((2, 16, 16, 3))
+    v = net.init({"params": jax.random.key(0), "gumbel": jax.random.key(1)},
+                 x, train=True)
+    out, _ = net.apply(v, x, train=True, mutable=["batch_stats"],
+                       rngs={"gumbel": jax.random.key(2)})
+    assert out.shape == (2, 4)
+    # eval path is deterministic (argmax ops, no rng needed)
+    out_eval = net.apply(v, x, train=False)
+    assert out_eval.shape == (2, 4)
