@@ -1,4 +1,4 @@
-from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg
+from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg, LeNet
 from fedml_tpu.models.gan import Discriminator, Generator
 from fedml_tpu.models.linear import LogisticRegression
 from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
@@ -16,6 +16,7 @@ from fedml_tpu.models.vgg import VGG
 __all__ = [
     "CNNDropOut",
     "CNNOriginalFedAvg",
+    "LeNet",
     "CifarResNet",
     "Discriminator",
     "Generator",

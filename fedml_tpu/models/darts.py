@@ -183,9 +183,21 @@ class Genotype:
     reduce: list[tuple[str, int]]
 
 
-def decode_genotype(alphas_normal: np.ndarray, alphas_reduce: np.ndarray, steps: int = 3) -> Genotype:
+def steps_from_edges(num_edges_: int) -> int:
+    """Invert num_edges: E = steps*(steps+3)/2."""
+    steps = int((np.sqrt(9 + 8 * num_edges_) - 3) / 2)
+    if num_edges(steps) != num_edges_:
+        raise ValueError(f"{num_edges_} is not a valid DARTS edge count")
+    return steps
+
+
+def decode_genotype(alphas_normal: np.ndarray, alphas_reduce: np.ndarray,
+                    steps: int | None = None) -> Genotype:
     """Argmax decode (genotypes.py / FedNASAggregator.record_model_global_
-    architecture:173): per node keep the 2 strongest non-'none' incoming edges."""
+    architecture:173): per node keep the 2 strongest non-'none' incoming
+    edges. ``steps`` is inferred from the alpha row count by default."""
+    if steps is None:
+        steps = steps_from_edges(len(np.asarray(alphas_normal)))
 
     def _decode(alphas):
         gene = []

@@ -102,3 +102,14 @@ def stage_global(host_array: np.ndarray, sharding):
     return jax.make_array_from_callback(
         host_array.shape, sharding, lambda idx: host_array[idx]
     )
+
+
+def flatten_variables(variables) -> np.ndarray:
+    """Canonical flat f32 view of a model pytree (leaf order = jax.tree
+    order) — the npz exchange format used by the multihost entry/tests to
+    compare controllers' results."""
+    import jax
+
+    return np.concatenate([
+        np.ravel(np.asarray(l)) for l in jax.tree.leaves(variables)
+    ])

@@ -7,7 +7,11 @@ import sys
 
 
 def main(process_id: int, num_processes: int, port: int, out_path: str) -> None:
-    from fedml_tpu.parallel.multihost import global_client_mesh, init_multihost
+    from fedml_tpu.parallel.multihost import (
+        flatten_variables,
+        global_client_mesh,
+        init_multihost,
+    )
 
     init_multihost(
         coordinator_address=f"localhost:{port}",
@@ -42,10 +46,8 @@ def main(process_id: int, num_processes: int, port: int, out_path: str) -> None:
     sim = FedSim(trainer, train, test, cfg, mesh=mesh)
     variables, history = sim.run()
     # every controller sees the same replicated result
-    flat = np.concatenate([
-        np.ravel(np.asarray(l)) for l in jax.tree.leaves(variables)
-    ])
-    np.savez(out_path, flat=flat, test_acc=history[-1]["Test/Acc"])
+    np.savez(out_path, flat=flatten_variables(variables),
+             test_acc=history[-1]["Test/Acc"])
 
 
 if __name__ == "__main__":

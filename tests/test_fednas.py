@@ -75,3 +75,11 @@ def test_fednas_gdas_search_end_to_end():
     # a genotype still decodes from the searched alphas
     g = global_genotype(out)
     assert len(g.normal) == 4
+
+
+def test_decode_genotype_infers_steps():
+    # steps inferred from the alpha row count — steps=4 yields 2 genes/node x 4
+    E4 = num_edges(4)
+    rng = np.random.RandomState(0)
+    g = decode_genotype(rng.randn(E4, len(PRIMITIVES)), rng.randn(E4, len(PRIMITIVES)))
+    assert len(g.normal) == 8 and len(g.reduce) == 8

@@ -36,25 +36,22 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.mark.slow
-def test_two_process_fedavg_matches_single_process(tmp_path):
-    port = _free_port()
-    outs = [tmp_path / f"proc{i}.npz" for i in range(2)]
+def _run_procs(argvs, timeout=300):
+    """Spawn one process per argv, collect logs, kill leftovers on timeout."""
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers set their own device count
     env["JAX_PLATFORMS"] = "cpu"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(WORKER), str(i), "2", str(port), str(outs[i])],
-            env=env, cwd=str(REPO),
+            argv, env=env, cwd=str(REPO),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         )
-        for i in range(2)
+        for argv in argvs
     ]
     logs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=timeout)
             logs.append(out.decode(errors="replace"))
     finally:
         for p in procs:
@@ -62,6 +59,17 @@ def test_two_process_fedavg_matches_single_process(tmp_path):
                 p.kill()
                 p.wait()
     assert all(p.returncode == 0 for p in procs), "\n".join(logs)[-4000:]
+    return logs
+
+
+@pytest.mark.slow
+def test_two_process_fedavg_matches_single_process(tmp_path):
+    port = _free_port()
+    outs = [tmp_path / f"proc{i}.npz" for i in range(2)]
+    _run_procs([
+        [sys.executable, str(WORKER), str(i), "2", str(port), str(outs[i])]
+        for i in range(2)
+    ])
 
     # both controllers converged to the same replicated model
     a = np.load(outs[0])
@@ -89,3 +97,22 @@ def test_two_process_fedavg_matches_single_process(tmp_path):
         np.ravel(np.asarray(l)) for l in jax.tree.leaves(variables)
     ])
     np.testing.assert_allclose(a["flat"], flat, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_cli_entry(tmp_path):
+    """The main_multihost experiment entry: 2 CLI processes, identical
+    final models."""
+    port = _free_port()
+    outs = [tmp_path / f"cli{i}.npz" for i in range(2)]
+    _run_procs([
+        [sys.executable, "-m", "fedml_tpu.exp.main_multihost",
+         "--coordinator", f"localhost:{port}",
+         "--num_processes", "2", "--process_id", str(i),
+         "--local_device_count", "2", "--platform", "cpu",
+         "--comm_round", "3", "--frequency_of_the_test", "3",
+         "--out", str(outs[i])]
+        for i in range(2)
+    ])
+    a, b = np.load(outs[0]), np.load(outs[1])
+    np.testing.assert_allclose(a["flat"], b["flat"], rtol=1e-6)
