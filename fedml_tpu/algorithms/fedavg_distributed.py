@@ -57,8 +57,23 @@ class FedAvgDistAggregator:
         self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
         self._lock = threading.Lock()  # reference hazard fixed (SURVEY §5.2)
 
+    def exclude_worker(self, index: int) -> None:
+        """Permanently stop expecting this worker (marked OFFLINE): later
+        rounds complete on the live set alone instead of re-waiting for the
+        timeout every round."""
+        with self._lock:
+            self.flag_client_model_uploaded_dict.pop(index, None)
+            self.model_dict.pop(index, None)
+            self.sample_num_dict.pop(index, None)
+
+    def live_workers(self) -> list[int]:
+        with self._lock:
+            return sorted(self.flag_client_model_uploaded_dict)
+
     def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
         with self._lock:
+            if index not in self.flag_client_model_uploaded_dict:
+                return False  # excluded (OFFLINE) worker resurfaced; ignore
             self.model_dict[index] = flat_params
             self.sample_num_dict[index] = sample_num
             self.flag_client_model_uploaded_dict[index] = True
@@ -108,6 +123,11 @@ class FedAvgServerManager(ServerManager):
         # they are marked OFFLINE in ``status`` (reference behavior: a dead
         # client hangs the round forever, mpi com_manager has no recovery)
         self.round_timeout = round_timeout
+        # a worker missing this many CONSECUTIVE timed-out rounds is
+        # permanently excluded (single misses — e.g. round-0 compile skew —
+        # only drop it from that round's aggregate)
+        self.exclude_after = 2
+        self._miss_counts: dict[int, int] = {}
         from fedml_tpu.comm.status import ClientStatusTracker
 
         self.status = ClientStatusTracker(worker_num)
@@ -141,15 +161,19 @@ class FedAvgServerManager(ServerManager):
         sender = msg.get_sender_id()
         from fedml_tpu.comm.status import ClientStatus
 
-        self.status.update(sender, ClientStatus.ONLINE)
         flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
         upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-        # staleness check and tally are one critical section: a timer closing
-        # the round between them would otherwise let a round-r model slip
-        # into round r+1's tally
+        # staleness/exclusion checks and the tally are one critical section:
+        # a timer closing the round between them would otherwise let a
+        # round-r model slip into round r+1's tally
         with self._round_lock:
             current = self.round_idx
+            if sender - 1 not in self.aggregator.live_workers():
+                # excluded (OFFLINE) worker resurfaced: stays excluded (and
+                # stays OFFLINE in the status table)
+                logging.info("ignoring upload from excluded worker %d", sender)
+                return
             if upload_round is not None and int(upload_round) != current:
                 # a straggler's upload from a timed-out round: one-round-stale
                 # model, must not pollute the current tally
@@ -158,9 +182,11 @@ class FedAvgServerManager(ServerManager):
                     sender, upload_round, current,
                 )
                 return
+            self.status.update(sender, ClientStatus.ONLINE)
             all_received = self.aggregator.add_local_trained_result(
                 sender - 1, flat, n
             )
+            self._miss_counts.pop(sender - 1, None)  # it spoke: reset misses
             if not all_received and self.round_timeout is not None:
                 if self._round_timer is None:
                     self._round_timer = threading.Timer(
@@ -172,22 +198,45 @@ class FedAvgServerManager(ServerManager):
             self._complete_round(current)
 
     def _round_timed_out(self, expected_round: int) -> None:
+        from fedml_tpu.comm.status import ClientStatus
+
         with self._round_lock:
             if self.round_idx != expected_round:
                 return  # the round completed while this timer was in flight
-        got = self.aggregator.received_workers()
-        if not got:
-            return  # nothing to aggregate; keep waiting
-        from fedml_tpu.comm.status import ClientStatus
-
-        missing = sorted(set(range(self.worker_num)) - set(got))
-        for w in missing:
-            self.status.update(w + 1, ClientStatus.OFFLINE)
+            got = self.aggregator.received_workers()
+            if not got:
+                # nothing to aggregate; release the timer slot so the next
+                # upload re-arms it
+                self._round_timer = None
+                return
+            # snapshot + miss accounting + exclusion stay under the lock:
+            # an in-time upload accepted concurrently must either appear in
+            # ``got`` or be rejected by the exclusion check — never both
+            # tallied and excluded
+            missing = sorted(set(self.aggregator.live_workers()) - set(got))
+            excluded = []
+            for w in missing:
+                self._miss_counts[w] = self._miss_counts.get(w, 0) + 1
+                if self._miss_counts[w] >= self.exclude_after:
+                    # consecutive misses: presumed dead — stop expecting it
+                    # so later rounds complete without another timeout
+                    self.status.update(w + 1, ClientStatus.OFFLINE)
+                    self.aggregator.exclude_worker(w)
+                    excluded.append(w + 1)
         logging.warning(
-            "round %d timed out: aggregating %d/%d workers, dropping %s "
-            "(marked OFFLINE, weights renormalized)",
-            expected_round, len(got), self.worker_num, [w + 1 for w in missing],
+            "round %d timed out: aggregating %d/%d workers, dropping %s"
+            "%s (weights renormalized)",
+            expected_round, len(got), self.worker_num,
+            [w + 1 for w in missing],
+            f", excluding {excluded} as OFFLINE" if excluded else "",
         )
+        for w in excluded:
+            # tell the excluded client to stop: it would otherwise keep
+            # training models the server discards every round
+            stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w)
+            stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            stop.add_params("finished", 1)
+            self.send_message(stop)
         self._complete_round(expected_round)
 
     def _complete_round(self, expected_round: int) -> None:
@@ -213,7 +262,7 @@ class FedAvgServerManager(ServerManager):
             self.finish()
             return
         cohort = rnglib.sample_clients(self.round_idx, self.client_num_in_total, self.worker_num)
-        for w in range(self.worker_num):
+        for w in self.aggregator.live_workers():
             sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
             sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
             sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
@@ -357,7 +406,8 @@ def run_distributed_fedavg_loopback(
     fabric = LoopbackFabric(worker_num + 1)
     return run_distributed_fedavg(
         trainer, train_data, worker_num, round_num, batch_size,
-        lambda r: LoopbackCommManager(fabric, r), seed, on_round_done,
+        lambda r: LoopbackCommManager(fabric, r), seed=seed,
+        on_round_done=on_round_done,
     )
 
 
@@ -384,7 +434,7 @@ def run_distributed_fedavg_shm(
     try:
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
-            lambda r: mgrs[r], seed, on_round_done,
+            lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
         )
     finally:
         for m in mgrs.values():
@@ -413,7 +463,7 @@ def run_distributed_fedavg_grpc(
     try:
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
-            lambda r: mgrs[r], seed, on_round_done,
+            lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
         )
     finally:
         for m in mgrs.values():

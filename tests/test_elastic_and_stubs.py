@@ -68,7 +68,7 @@ def test_dead_client_does_not_hang_rounds():
     fd.FedAvgServerManager, restore = CapturingServer, orig
     try:
         final = fd.run_distributed_fedavg(
-            trainer, train, worker_num=4, round_num=2, batch_size=8,
+            trainer, train, worker_num=4, round_num=3, batch_size=8,
             make_comm=make_comm, seed=0, round_timeout=1.0,
         )
     finally:
@@ -77,9 +77,12 @@ def test_dead_client_does_not_hang_rounds():
     flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(final)])
     assert np.all(np.isfinite(flat))
     server = server_holder["server"]
-    assert server.round_idx == 2
-    # the dead worker (rank 3) was detected and marked OFFLINE
+    assert server.round_idx == 3
+    # the dead worker (rank 3) missed exclude_after consecutive rounds: it is
+    # marked OFFLINE and permanently excluded, and the final round completed
+    # on the live set without waiting out another timeout
     assert server.status.snapshot().get(3) == ClientStatus.OFFLINE
+    assert server.aggregator.live_workers() == [0, 1, 3]
 
 
 # ---------------------------------------------------------------------------
@@ -269,8 +272,10 @@ def test_s3_store_with_stub_boto3(monkeypatch):
 
 
 class _SlowComm(LoopbackCommManager):
-    """Client transport that delays every upload past the round timeout —
-    the stale uploads must be rejected by their round stamp, not averaged
+    """Client transport that delays every upload past the round timeout
+    (1.5s vs 1.0s: late enough to miss each round, early enough that the
+    stale upload arrives while the server is still running) — the stale
+    uploads must be rejected by their round stamp / exclusion, not averaged
     into later rounds."""
 
     def send_message(self, msg: Message) -> None:
@@ -280,7 +285,7 @@ class _SlowComm(LoopbackCommManager):
             def later():
                 import time
 
-                time.sleep(2.5)
+                time.sleep(1.5)
                 super(_SlowComm, self).send_message(msg)
 
             threading.Thread(target=later, daemon=True).start()
@@ -296,16 +301,22 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
         module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=1
     )
     fabric = LoopbackFabric(4)
-    stale_log = []
+    server_holder = {}
 
     orig = fd.FedAvgServerManager
+    rejected = []
 
-    class StaleLogServer(orig):
+    class CapturingServer(orig):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            server_holder["server"] = self
+
         def _on_model_from_client(self, msg):
             r = msg.get(fd.MyMessage.MSG_ARG_KEY_ROUND_IDX)
             with self._round_lock:
-                if r is not None and int(r) != self.round_idx:
-                    stale_log.append((msg.get_sender_id(), int(r), self.round_idx))
+                if (msg.get_sender_id() - 1 not in self.aggregator.live_workers()
+                        or (r is not None and int(r) != self.round_idx)):
+                    rejected.append((msg.get_sender_id(), int(r)))
             super()._on_model_from_client(msg)
 
     def make_comm(rank):
@@ -313,19 +324,24 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
             return _SlowComm(fabric, rank)
         return LoopbackCommManager(fabric, rank)
 
-    fd.FedAvgServerManager = StaleLogServer
+    fd.FedAvgServerManager = CapturingServer
     try:
         final = fd.run_distributed_fedavg(
-            trainer, train, worker_num=3, round_num=3, batch_size=8,
+            trainer, train, worker_num=3, round_num=4, batch_size=8,
             make_comm=make_comm, seed=0, round_timeout=1.0,
         )
     finally:
         fd.FedAvgServerManager = orig
     flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(final)])
     assert np.all(np.isfinite(flat))
-    # at least one of the slow worker's late round-r uploads arrived when the
-    # server had already advanced — and was rejected rather than averaged in
-    assert any(sender == 2 and sent_r < cur for sender, sent_r, cur in stale_log), stale_log
+    server = server_holder["server"]
+    # the consistently-slow worker (1.5s vs 1.0s timeout) misses every
+    # round; after exclude_after consecutive misses it is excluded, and its
+    # late stale-stamped uploads are rejected (observed!) rather than
+    # averaged into later rounds
+    assert server.round_idx == 4
+    assert server.aggregator.live_workers() == [0, 2]
+    assert any(sender == 2 for sender, _ in rejected), rejected
 
 
 def test_status_tracker_stale_detection():
