@@ -70,6 +70,10 @@ class FedAvgDistAggregator:
         with self._lock:
             return sorted(self.flag_client_model_uploaded_dict)
 
+    def is_live(self, index: int) -> bool:
+        with self._lock:
+            return index in self.flag_client_model_uploaded_dict
+
     def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
         with self._lock:
             if index not in self.flag_client_model_uploaded_dict:
@@ -109,6 +113,7 @@ class FedAvgServerManager(ServerManager):
                  init_flat: np.ndarray, model_desc: str,
                  client_num_in_total: int | None = None,
                  round_timeout: float | None = None,
+                 exclude_after: int = 2,
                  on_round_done: Callable[[int, np.ndarray], None] | None = None):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
@@ -126,7 +131,7 @@ class FedAvgServerManager(ServerManager):
         # a worker missing this many CONSECUTIVE timed-out rounds is
         # permanently excluded (single misses — e.g. round-0 compile skew —
         # only drop it from that round's aggregate)
-        self.exclude_after = 2
+        self.exclude_after = exclude_after
         self._miss_counts: dict[int, int] = {}
         from fedml_tpu.comm.status import ClientStatusTracker
 
@@ -169,7 +174,7 @@ class FedAvgServerManager(ServerManager):
         # round-r model slip into round r+1's tally
         with self._round_lock:
             current = self.round_idx
-            if sender - 1 not in self.aggregator.live_workers():
+            if not self.aggregator.is_live(sender - 1):
                 # excluded (OFFLINE) worker resurfaced: stays excluded (and
                 # stays OFFLINE in the status table)
                 logging.info("ignoring upload from excluded worker %d", sender)

@@ -22,9 +22,26 @@ import jax
 
 from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
 from fedml_tpu.comm.message import Message
-from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.core.trainer import ClientTrainer, make_local_train
 from fedml_tpu.data.synthetic import gaussian_blobs
 from fedml_tpu.models.linear import LogisticRegression
+
+
+def _warm_jit(trainer, train, batch_size):
+    """Compile the local-train program once so the elastic-timing tests do
+    not depend on cold-compile latency (XLA's executable cache then serves
+    every client manager's identical program instantly)."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.sim.cohort import stack_cohort
+
+    batches, _ = stack_cohort(train, np.asarray([0]), batch_size)
+    batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
+    sample = jax.tree.map(lambda v: v[0], batches)
+    variables = trainer.init(jax.random.key(0), sample)
+    fn = jax.jit(make_local_train(trainer))
+    out, _ = fn(variables, batches, jax.random.key(1))
+    jax.block_until_ready(jax.tree.leaves(out)[0])
 
 
 # ---------------------------------------------------------------------------
@@ -50,6 +67,7 @@ def test_dead_client_does_not_hang_rounds():
     )
     fabric = LoopbackFabric(5)
     server_holder = {}
+    _warm_jit(trainer, train, 8)
 
     def make_comm(rank):
         if rank == 3:  # this worker's uploads vanish
@@ -302,6 +320,7 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
     )
     fabric = LoopbackFabric(4)
     server_holder = {}
+    _warm_jit(trainer, train, 8)
 
     orig = fd.FedAvgServerManager
     rejected = []

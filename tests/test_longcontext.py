@@ -168,3 +168,37 @@ def test_flash_bwd_fully_masked_rows(rng):
     np.testing.assert_array_equal(np.asarray(g1[0][:, :, :n_masked]), 0.0)
     for a, b_ in zip(g1, g2):
         np.testing.assert_allclose(a, b_, atol=1e-4)
+
+
+def test_transformer_remat_matches_plain():
+    """jax.checkpoint on blocks must not change values or gradients."""
+    import numpy as np
+    import optax
+
+    from fedml_tpu.models.transformer import TransformerLM
+
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 50, (2, 16)), jnp.int32)
+    y = jnp.asarray(np.random.RandomState(1).randint(0, 50, (2, 16)), jnp.int32)
+    plain = TransformerLM(vocab_size=50, embed_dim=32, num_layers=2, num_heads=4,
+                          max_len=16)
+    remat = TransformerLM(vocab_size=50, embed_dim=32, num_layers=2, num_heads=4,
+                          max_len=16, remat=True)
+    v = plain.init({"params": jax.random.key(0)}, x, train=False)
+
+    def loss(model, variables):
+        logits = model.apply(variables, x, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    l1, g1 = jax.value_and_grad(lambda v_: loss(plain, v_))(v)
+    l2, g2 = jax.value_and_grad(lambda v_: loss(remat, v_))(v)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    # the remat wrapper must also train with dropout (train is static)
+    dr = TransformerLM(vocab_size=50, embed_dim=32, num_layers=2, num_heads=4,
+                       max_len=16, remat=True, dropout_rate=0.1)
+    vd = dr.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
+                 x, train=True)
+    out = dr.apply(vd, x, train=True, rngs={"dropout": jax.random.key(2)})
+    assert np.isfinite(np.asarray(out)).all()
