@@ -66,6 +66,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--norm_bound", type=float, default=0.0)
     parser.add_argument("--stddev", type=float, default=0.0)
     parser.add_argument("--robust_rule", type=str, default="mean")
+    # engine knobs
+    parser.add_argument("--eval_on_clients", type=int, default=0,
+                        help="also run the vectorized per-client server eval "
+                             "at test rounds (FedAVGAggregator "
+                             "test_on_server_for_all_clients)")
+    parser.add_argument("--stage_on_device", type=int, default=-1,
+                        help="-1 auto, 0 host staging, 1 device-resident "
+                             "dataset + in-program gather")
+    parser.add_argument("--profile_dir", type=str, default=None,
+                        help="capture a jax.profiler trace of the round loop")
     # observability
     parser.add_argument("--run_dir", type=str, default=None)
     parser.add_argument("--enable_wandb", type=int, default=0)
@@ -232,6 +242,10 @@ def run(args) -> list[dict]:
         frequency_of_the_test=args.frequency_of_the_test if not args.ci else args.comm_round,
         seed=args.seed,
         straggler_frac=args.straggler_frac,
+        eval_on_clients=bool(args.eval_on_clients),
+        stage_on_device=(None if args.stage_on_device < 0
+                         else bool(args.stage_on_device)),
+        profile_dir=args.profile_dir,
     )
 
     metrics = MetricsLogger(run_dir=args.run_dir, use_wandb=bool(args.enable_wandb))
@@ -292,28 +306,48 @@ def run(args) -> list[dict]:
 
         ckptr = RoundCheckpointer(args.checkpoint_dir)
 
-    # checkpoint/resume-aware run loop
-    from fedml_tpu.core import rng as rnglib
-
+    # checkpoint/resume-aware run. Without checkpointing, the engine's
+    # run() drives everything (block dispatch, profiling, per-client eval).
+    # With checkpointing, rounds run one dispatch at a time so every saved
+    # round has its exact model state.
     variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     start_round = 0
     history: list[dict] = []
     if args.resume and ckptr is not None and ckptr.latest_round() is not None:
-        variables, server_state, start_round, history = ckptr.restore(variables, like_server_state=server_state)
+        variables, server_state, start_round, history = ckptr.restore(
+            variables, like_server_state=server_state
+        )
         start_round += 1
         logging.info("resumed from round %d", start_round - 1)
 
+    if ckptr is None or not args.checkpoint_every:
+        _, run_history = sim.run(
+            callback=lambda rec: metrics.log(rec, round_idx=rec["round"]),
+            variables=variables, server_state=server_state,
+            start_round=start_round,
+        )
+        metrics.close()
+        return history + run_history
+
+    from fedml_tpu.core import rng as rnglib
+
+    if cfg.profile_dir:
+        logging.warning(
+            "--profile_dir is not captured on the checkpointed per-round "
+            "path; run without --checkpoint_every to profile"
+        )
+    freq = max(cfg.frequency_of_the_test, 1)
     root = rnglib.root_key(cfg.seed)
     for r in range(start_round, cfg.comm_round):
         variables, server_state, m = sim.run_round(r, variables, server_state, root)
         jax.block_until_ready(jax.tree_util.tree_leaves(variables)[0])
         rec = {"round": r, **{k: float(v) for k, v in m.items()}}
-        if (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
-            rec.update(sim.evaluate(sim.consensus(variables)))
+        if (r + 1) % freq == 0 or r == cfg.comm_round - 1:
+            rec.update(sim.eval_record(variables))
         history.append(rec)
         metrics.log(rec, round_idx=r)
-        if ckptr is not None and args.checkpoint_every and (r + 1) % args.checkpoint_every == 0:
+        if (r + 1) % args.checkpoint_every == 0:
             ckptr.save(r, variables, server_state, history)
     metrics.close()
     return history

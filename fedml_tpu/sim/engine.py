@@ -632,6 +632,15 @@ class FedSim:
             "Train/LossOnClients": float(m["test_loss"].sum()) / total,
         }
 
+    def eval_record(self, variables) -> dict[str, float]:
+        """The test-round metric block: pooled eval (+ per-client summary
+        when configured). One definition for every run loop."""
+        eval_vars = self.consensus(variables)
+        out = self.evaluate(eval_vars)
+        if self.config.eval_on_clients:
+            out.update(self.per_client_summary(eval_vars))
+        return out
+
     def evaluate(self, variables) -> dict[str, float]:
         if not self._can_eval:
             return {}
@@ -649,10 +658,16 @@ class FedSim:
             out["Test/Loss"] = float(test_m["Loss"])
         return out
 
-    def run(self, callback=None) -> tuple[Pytree, list[dict]]:
+    def run(self, callback=None, variables=None, server_state=None,
+            start_round: int = 0) -> tuple[Pytree, list[dict]]:
+        """Run the configured rounds. ``variables``/``server_state``/
+        ``start_round`` resume from a checkpoint (obs/checkpoint.py);
+        defaults start fresh."""
         cfg = self.config
-        variables = self.init_round_variables()
-        server_state = self.aggregator.init_state(variables)
+        if variables is None:
+            variables = self.init_round_variables()
+        if server_state is None:
+            server_state = self.aggregator.init_state(variables)
         root = rnglib.root_key(cfg.seed)
         history = []
         profiling = False
@@ -662,20 +677,20 @@ class FedSim:
         # round); single-round blocks when the dataset is host-staged.
         freq = max(cfg.frequency_of_the_test, 1)
         try:
-            r = 0
+            r = start_round
             while r < cfg.comm_round:
-                # start the trace after round 0 so compilation doesn't drown
-                # the steady-state rounds in the profile (a 1-round run
-                # traces its only round, compilation included)
+                # start the trace after the first round so compilation
+                # doesn't drown the steady-state rounds in the profile (a
+                # 1-round run traces its only round, compilation included)
                 if cfg.profile_dir and not profiling and (
-                    r > 0 or cfg.comm_round == 1
+                    r > start_round or cfg.comm_round - start_round == 1
                 ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
                 next_eval = ((r // freq) + 1) * freq
                 n = min(cfg.comm_round, next_eval) - r if self._on_device else 1
-                # round 0 runs alone so the trace/profile skips compilation
-                if cfg.profile_dir and r == 0:
+                # the first round runs alone so the profile skips compilation
+                if cfg.profile_dir and r == start_round:
                     n = 1
                 t0 = time.perf_counter()
                 if n == 1:
@@ -699,11 +714,8 @@ class FedSim:
                         "round_time": (block_time / n) if j == n - 1 else None,
                     }
                     rec.update({k: float(v[j]) for k, v in stacked.items()})
-                    if (rr + 1) % cfg.frequency_of_the_test == 0 or rr == cfg.comm_round - 1:
-                        eval_vars = self.consensus(variables)
-                        rec.update(self.evaluate(eval_vars))
-                        if cfg.eval_on_clients:
-                            rec.update(self.per_client_summary(eval_vars))
+                    if (rr + 1) % freq == 0 or rr == cfg.comm_round - 1:
+                        rec.update(self.eval_record(variables))
                     rec = {k: v for k, v in rec.items() if v is not None}
                     history.append(rec)
                     if callback:
