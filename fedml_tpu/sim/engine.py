@@ -298,29 +298,35 @@ class FedSim:
         }
         return new_global, server_state, metrics
 
-    def _gather_round_impl(self, global_variables, server_state, dataset, idx,
-                           weights, num_steps, rng):
-        # Build this shard's batch stack on device: ``idx`` [C_local, S, B]
-        # indexes dataset rows, -1 marks an empty padding slot. Semantics
-        # mirror cohort.stack_cohort exactly (zero-fill + example mask,
-        # token masks multiplied by example validity).
+    @staticmethod
+    def _gather_batches(dataset, idx):
+        """Gather [*, S, B] index maps (-1 = empty slot) into batch stacks
+        with stack_cohort's exact zero-fill/mask semantics — the one
+        definition used by the round, pooled-eval, and per-client-eval
+        gather programs."""
         valid = (idx >= 0).astype(jnp.float32)
         safe = jnp.maximum(idx, 0).reshape(-1)
         batches = {
             k: jnp.take(v, safe, axis=0).reshape(idx.shape + v.shape[1:])
             for k, v in dataset.items()
         }
-        # zero-fill padding slots so the stack is bit-identical to the host
-        # staging path (stack_cohort's np.zeros initialization); this also
-        # folds example validity into a per-token "mask" field if present
         batches = {
-            k: v * valid.reshape(valid.shape + (1,) * (v.ndim - 3)).astype(v.dtype)
+            k: v * valid.reshape(
+                valid.shape + (1,) * (v.ndim - idx.ndim)
+            ).astype(v.dtype)
             for k, v in batches.items()
         }
         if "mask" in dataset:
             batches["mask"] = batches["mask"].astype(jnp.float32)
         else:
             batches["mask"] = valid
+        return batches
+
+    def _gather_round_impl(self, global_variables, server_state, dataset, idx,
+                           weights, num_steps, rng):
+        # Build this shard's batch stack on device: ``idx`` [C_local, S, B]
+        # indexes dataset rows, -1 marks an empty padding slot.
+        batches = self._gather_batches(dataset, idx)
         return self._round_impl(
             global_variables, server_state, batches, weights, num_steps, rng
         )
@@ -408,21 +414,7 @@ class FedSim:
 
     def _eval_gather_impl(self, variables, dataset, idx):
         # pooled-eval analogue of _gather_round_impl: idx [S, B], -1 = pad
-        valid = (idx >= 0).astype(jnp.float32)
-        safe = jnp.maximum(idx, 0).reshape(-1)
-        batches = {
-            k: jnp.take(v, safe, axis=0).reshape(idx.shape + v.shape[1:])
-            for k, v in dataset.items()
-        }
-        batches = {
-            k: v * valid.reshape(valid.shape + (1,) * (v.ndim - 2)).astype(v.dtype)
-            for k, v in batches.items()
-        }
-        if "mask" in dataset:
-            batches["mask"] = batches["mask"].astype(jnp.float32)
-        else:
-            batches["mask"] = valid
-        return self._eval_impl(variables, batches)
+        return self._eval_impl(variables, self._gather_batches(dataset, idx))
 
     # -- host driver ---------------------------------------------------------
 
@@ -596,6 +588,7 @@ class FedSim:
         """
         if not self._can_eval:
             return {}
+        use_resident = data is None and self._on_device
         data = data if data is not None else self.train_data
         ids = np.asarray(
             client_ids if client_ids is not None else np.arange(data.num_clients)
@@ -605,15 +598,37 @@ class FedSim:
         bs = batch_size or self.config.eval_batch_size
         steps = cohortlib.steps_per_epoch(data.max_client_size(), bs)
         csz = min(chunk, len(ids))
+        if use_resident and not hasattr(self, "_client_eval_gather_fn"):
+            # gather the chunk's batches from the HBM-resident dataset:
+            # per-chunk upload is one [C, S, B] index map, not the samples
+            def _impl(variables, dataset, idx):
+                batches = self._gather_batches(dataset, idx)
+                return jax.vmap(self._local_eval, in_axes=(None, 0))(
+                    variables, batches
+                )
+
+            self._client_eval_gather_fn = jax.jit(_impl)
         outs = []
         for lo in range(0, len(ids), csz):
             sel = ids[lo : lo + csz]
             pad = csz - len(sel)
             padded = np.concatenate([sel, np.repeat(sel[-1:], pad)]) if pad else sel
-            stack = cohortlib.stack_client_eval(data, padded, bs, steps=steps)
-            if pad:  # fully mask the duplicate tail clients
-                stack["mask"][len(sel):] = 0.0
-            m = self._client_eval_fn(variables, jax.tree.map(jnp.asarray, stack))
+            if use_resident:
+                slots = steps * bs
+                idx = np.full((csz, slots), -1, np.int32)
+                for ci, cid in enumerate(sel):  # pad rows stay -1 (masked)
+                    rows = data.partition[int(cid)]
+                    n = min(len(rows), slots)
+                    idx[ci, :n] = rows[:n]
+                m = self._client_eval_gather_fn(
+                    variables, self._dataset,
+                    self._put(idx.reshape(csz, steps, bs), self._rep),
+                )
+            else:
+                stack = cohortlib.stack_client_eval(data, padded, bs, steps=steps)
+                if pad:  # fully mask the duplicate tail clients
+                    stack["mask"][len(sel):] = 0.0
+                m = self._client_eval_fn(variables, jax.tree.map(jnp.asarray, stack))
             outs.append(jax.tree.map(lambda x: np.asarray(x)[: len(sel)], m))
         return {
             k: np.concatenate([o[k] for o in outs]) for k in outs[0]

@@ -69,3 +69,23 @@ def test_profile_dir_produces_trace(tmp_path):
     sim.run()
     produced = list(prof.rglob("*"))
     assert any(p.is_file() for p in produced), "no profile artifact written"
+
+
+def test_per_client_eval_resident_matches_host_path():
+    import dataclasses
+
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(n_clients=6, samples_per_client=40, num_classes=4, seed=3)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.3), epochs=1
+    )
+    base = SimConfig(client_num_in_total=6, client_num_per_round=6,
+                     batch_size=20, comm_round=1, seed=0)
+    on = FedSim(trainer, train, test, dataclasses.replace(base, stage_on_device=True))
+    off = FedSim(trainer, train, test, dataclasses.replace(base, stage_on_device=False))
+    v = on.init_round_variables()
+    m_on = on.evaluate_per_client(v, chunk=4)
+    m_off = off.evaluate_per_client(off.init_round_variables(), chunk=4)
+    for k in m_off:
+        np.testing.assert_allclose(m_on[k], m_off[k], rtol=1e-6)
