@@ -210,6 +210,13 @@ class FedSim:
                     eidx.reshape(steps, bs), self._rep
                 )
                 self._eval_gather_fn = jax.jit(self._eval_gather_impl)
+                # per-client analogue: gather each chunk's batches from the
+                # resident dataset, then the same vmapped local eval
+                self._client_eval_gather_fn = jax.jit(
+                    lambda variables, dataset, idx: jax.vmap(
+                        self._local_eval, in_axes=(None, 0)
+                    )(variables, self._gather_batches(dataset, idx))
+                )
             else:
                 self._train_eval_batches = cohortlib.batch_array(
                     train_data.arrays, config.eval_batch_size
@@ -598,16 +605,6 @@ class FedSim:
         bs = batch_size or self.config.eval_batch_size
         steps = cohortlib.steps_per_epoch(data.max_client_size(), bs)
         csz = min(chunk, len(ids))
-        if use_resident and not hasattr(self, "_client_eval_gather_fn"):
-            # gather the chunk's batches from the HBM-resident dataset:
-            # per-chunk upload is one [C, S, B] index map, not the samples
-            def _impl(variables, dataset, idx):
-                batches = self._gather_batches(dataset, idx)
-                return jax.vmap(self._local_eval, in_axes=(None, 0))(
-                    variables, batches
-                )
-
-            self._client_eval_gather_fn = jax.jit(_impl)
         outs = []
         for lo in range(0, len(ids), csz):
             sel = ids[lo : lo + csz]

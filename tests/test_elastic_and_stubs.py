@@ -360,7 +360,25 @@ def test_slow_straggler_uploads_are_rejected_not_mixed():
     # averaged into later rounds
     assert server.round_idx == 4
     assert server.aggregator.live_workers() == [0, 2]
-    assert any(sender == 2 for sender, _ in rejected), rejected
+    # deterministic stale-rejection check (wall-clock overlap between the
+    # delayed uploads and the server's lifetime is scheduler-dependent):
+    # hand the server a live worker's upload stamped with an old round and
+    # assert it is rejected, not tallied
+    stale = Message(fd.MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 1, 0)
+    stale.add_params(fd.MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                     np.zeros(4, np.uint8))
+    stale.add_params(fd.MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+    stale.add_params(fd.MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+    server._on_model_from_client(stale)
+    assert (1, 0) in rejected
+    assert server.aggregator.received_workers() == []
+    # and an excluded worker's upload is likewise ignored
+    dead = Message(fd.MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, 2, 0)
+    dead.add_params(fd.MyMessage.MSG_ARG_KEY_MODEL_PARAMS, np.zeros(4, np.uint8))
+    dead.add_params(fd.MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+    dead.add_params(fd.MyMessage.MSG_ARG_KEY_ROUND_IDX, server.round_idx)
+    server._on_model_from_client(dead)
+    assert server.aggregator.received_workers() == []
 
 
 def test_status_tracker_stale_detection():
