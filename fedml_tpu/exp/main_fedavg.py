@@ -67,6 +67,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--stddev", type=float, default=0.0)
     parser.add_argument("--robust_rule", type=str, default="mean")
     # engine knobs
+    parser.add_argument("--augment", type=int, default=0,
+                        help="on-device crop/flip/cutout train augmentation "
+                             "(the reference's CIFAR-family torchvision "
+                             "pipeline)")
     parser.add_argument("--eval_on_clients", type=int, default=0,
                         help="also run the vectorized per-client server eval "
                              "at test rounds (FedAVGAggregator "
@@ -98,13 +102,27 @@ def build_trainer(args, model, dataset_name: str):
     if args.wd:
         opt = optax.chain(optax.add_decayed_weights(args.wd), opt)
     prox = args.fedprox_mu if args.algorithm == "fedprox" else 0.0
-    return ClientTrainer(
+    trainer = ClientTrainer(
         module=model,
         task=task_for_dataset(dataset_name),
         optimizer=opt,
         epochs=args.epochs,
         prox_mu=prox,
     )
+    if getattr(args, "augment", 0):
+        from fedml_tpu.ops.augment import ImageAugment, with_augmentation
+
+        if task_for_dataset(dataset_name) != "classification":
+            raise ValueError("--augment is for image classification datasets")
+        if dataset_name not in ("cifar10", "cifar100", "cinic10"):
+            raise ValueError(
+                "--augment currently implements the CIFAR-family pipeline "
+                "(pad-4 crop / flip / cutout-16, reference "
+                "cifar10/data_loader.py:58-76); compose "
+                "fedml_tpu.ops.augment primitives directly for other shapes"
+            )
+        trainer = with_augmentation(trainer, ImageAugment())
+    return trainer
 
 
 def build_aggregator(args, train_data):

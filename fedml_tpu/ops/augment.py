@@ -1,0 +1,115 @@
+"""On-device image augmentation for federated CV training.
+
+Reference: the torchvision transform pipelines built per DataLoader —
+RandomCrop(32, padding=4) + RandomHorizontalFlip + Cutout(16) for the CIFAR
+family (cifar10/data_loader.py:58-76) and RandomResizedCrop(224) + flip +
+Cutout for ImageNet/Landmarks (ImageNet/data_loader.py:43-67). The reference
+augments on the host, example by example, inside each DataLoader worker.
+
+TPU design: augmentation is pure array math inside the jitted round program —
+batched pad+dynamic-slice crops, sign flips, and rectangle masks, vmapped
+with per-example keys. The (already normalized, device-resident) dataset is
+augmented *after* the cohort gather, so the same resident arrays serve every
+round with fresh randomness and zero host involvement. Compose with
+ClientTrainer via ``with_augmentation`` (the ``augment`` hook applies inside
+``loss_fn`` before the forward pass, training only).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+Batch = dict
+
+
+def random_crop(img: jnp.ndarray, rng: jax.Array, padding: int = 4) -> jnp.ndarray:
+    """Pad-and-crop back to the original size (torchvision
+    RandomCrop(size, padding) semantics) for one [H, W, C] image."""
+    h, w, _ = img.shape
+    padded = jnp.pad(
+        img, ((padding, padding), (padding, padding), (0, 0)), mode="constant"
+    )
+    ky, kx = jax.random.split(rng)
+    dy = jax.random.randint(ky, (), 0, 2 * padding + 1)
+    dx = jax.random.randint(kx, (), 0, 2 * padding + 1)
+    return jax.lax.dynamic_slice(padded, (dy, dx, 0), img.shape)
+
+
+def random_flip(img: jnp.ndarray, rng: jax.Array) -> jnp.ndarray:
+    """Horizontal flip with p=0.5 for one [H, W, C] image."""
+    return jnp.where(jax.random.bernoulli(rng), img[:, ::-1, :], img)
+
+
+def cutout(img: jnp.ndarray, rng: jax.Array, length: int = 16) -> jnp.ndarray:
+    """Zero a random length x length square (reference Cutout,
+    ImageNet/data_loader.py:21-40) for one [H, W, C] image."""
+    h, w, _ = img.shape
+    ky, kx = jax.random.split(rng)
+    cy = jax.random.randint(ky, (), 0, h)
+    cx = jax.random.randint(kx, (), 0, w)
+    ys = jnp.arange(h)[:, None]
+    xs = jnp.arange(w)[None, :]
+    # [c - l//2, c + l//2): an exact length x length window (edge-clipped),
+    # matching reference Cutout's np.clip(y - length//2 .. y + length//2)
+    mask = (
+        (ys >= cy - length // 2) & (ys < cy + length // 2)
+        & (xs >= cx - length // 2) & (xs < cx + length // 2)
+    )
+    return img * (1.0 - mask.astype(img.dtype))[..., None]
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageAugment:
+    """The reference CIFAR/ImageNet train pipeline as one batched jit-safe
+    function: crop -> flip -> cutout, each per-example."""
+
+    padding: int = 4
+    cutout_length: int = 16
+    flip: bool = True
+
+    def __call__(self, batch: Batch, rng: jax.Array) -> Batch:
+        x = batch["x"]
+        if x.ndim != 4:
+            raise ValueError(
+                f"ImageAugment needs [B, H, W, C] images; got shape "
+                f"{tuple(x.shape)} — channel-less datasets (e.g. mnist "
+                f"[B, 28, 28]) need x[..., None] first"
+            )
+
+        def one(img, key):
+            k1, k2, k3 = jax.random.split(key, 3)
+            img = random_crop(img, k1, self.padding)
+            if self.flip:
+                img = random_flip(img, k2)
+            if self.cutout_length:
+                img = cutout(img, k3, self.cutout_length)
+            return img
+
+        keys = jax.random.split(rng, x.shape[0])
+        return {**batch, "x": jax.vmap(one)(x, keys)}
+
+
+def with_augmentation(trainer, augment: Callable[[Batch, jax.Array], Batch]):
+    """A ClientTrainer whose training forward sees augmented batches
+    (evaluation is untouched — the reference's valid_transform applies no
+    augmentation). Works anywhere a ClientTrainer does: the jitted round
+    program vmaps it over the cohort like any other trainer."""
+    import dataclasses as dc
+
+    base_loss_fn = type(trainer).loss_fn
+
+    class AugmentedTrainer(type(trainer)):
+        def loss_fn(self, params, model_state, global_params, batch, rng):
+            aug_rng, step_rng = jax.random.split(rng)
+            batch = augment(batch, aug_rng)
+            return base_loss_fn(
+                self, params, model_state, global_params, batch, step_rng
+            )
+
+    return AugmentedTrainer(**{
+        f.name: getattr(trainer, f.name) for f in dc.fields(trainer)
+    })
