@@ -26,6 +26,7 @@ def synthetic_classification(
     alpha: float = 0.0,
     beta: float = 0.0,
     seed: int = 0,
+    size_dist: str = "uniform",
 ) -> tuple[FederatedArrays, dict[str, np.ndarray]]:
     """LEAF-style synthetic(α, β) generator.
 
@@ -34,12 +35,22 @@ def synthetic_classification(
     W_k ~ N(u_k, 1), u_k ~ N(0, α); x ~ N(v_k, Σ), v_k ~ N(B_k, 1),
     B_k ~ N(0, β); y = argmax(softmax(W_k x + b_k)). Returns
     (train FederatedArrays, pooled test arrays).
+
+    ``size_dist="lognormal"`` draws per-client sample counts as
+    ``lognormal(4, 2) + 50`` — the reference generator's heavy-tailed
+    recipe (data/synthetic_1_1/generate_synthetic.py), used by the
+    BASELINE reproduction; "uniform" draws from ``samples_per_client``
+    (compact shapes for tests).
     """
     rng = np.random.RandomState(seed)
     sigma = np.diag(np.asarray([(j + 1) ** -1.2 for j in range(dim)]))
 
     xs, ys, owners = [], [], []
-    sizes = rng.randint(samples_per_client[0], samples_per_client[1] + 1, n_clients)
+    if size_dist == "lognormal":
+        sizes = (rng.lognormal(4.0, 2.0, n_clients).astype(int) + 50)
+        sizes = np.minimum(sizes, 10_000)  # bound the heavy tail
+    else:
+        sizes = rng.randint(samples_per_client[0], samples_per_client[1] + 1, n_clients)
     for k in range(n_clients):
         u_k = rng.normal(0.0, alpha)
         b_center = rng.normal(0.0, beta)

@@ -101,6 +101,8 @@ def run(args) -> dict:
 
 
 def _write_report(path: Path, args, result: dict, evals: list) -> None:
+    from fedml_tpu.exp._report import update_section
+
     curve = "\n".join(
         f"| {e['round']} | {e['Train/Acc']:.4f} | {e['Test/Acc']:.4f} |"
         for e in evals
@@ -119,7 +121,7 @@ def _write_report(path: Path, args, result: dict, evals: list) -> None:
             "MNIST-shaped data, not as a literal MNIST score."
         )
     )
-    path.write_text(f"""# BASELINE reproduction — MNIST + LogisticRegression (Linear Models row 1)
+    update_section(path, "mnist_lr", f"""# BASELINE reproduction — MNIST + LogisticRegression (Linear Models row 1)
 
 Reference target (BASELINE.md / benchmark/README.md:12-14): test acc **> 75**
 within **~100 rounds** — 1000 clients (power-law), 10/round, B=10, SGD

@@ -1,0 +1,119 @@
+"""BASELINE reproduction: Synthetic(α,β) + LogisticRegression (Linear row 3).
+
+Reference config (benchmark/README.md:12-18): 30 clients, 10/round, B=10,
+SGD lr=0.01, E=1 → test acc > 60 within >200 rounds, for
+(α,β) ∈ {(0,0), (0.5,0.5), (1,1)}. The generator is fully-specified math
+(FedProx paper recipe), so this row reproduces with no data caveats.
+
+Usage: python -m fedml_tpu.exp.repro_synthetic [--comm_round 250]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+
+
+def run(args) -> dict:
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs.metrics import logging_config
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    logging_config(0)
+    results = {}
+    for a, b in ((0.0, 0.0), (0.5, 0.5), (1.0, 1.0)):
+        train, test = synthetic_classification(
+            n_clients=args.client_num_in_total, alpha=a, beta=b,
+            seed=args.seed, size_dist="lognormal",  # reference sample sizes
+        )
+        trainer = ClientTrainer(
+            module=LogisticRegression(num_classes=10),
+            optimizer=optax.sgd(args.lr), epochs=1,
+        )
+        cfg = SimConfig(
+            client_num_in_total=args.client_num_in_total,
+            client_num_per_round=args.client_num_per_round,
+            batch_size=args.batch_size, comm_round=args.comm_round, epochs=1,
+            frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
+        )
+        _, hist = FedSim(trainer, train, test, cfg).run()
+        evals = [(h["round"], h["Test/Acc"]) for h in hist if "Test/Acc" in h]
+        best = max(acc for _, acc in evals)
+        first60 = next((r for r, acc in evals if acc > 0.6), None)
+        results[f"synthetic({a},{b})"] = {
+            "best_test_acc": round(best, 4), "first_round_over_60": first60,
+            "clients_sizes_minmax": [int(train.client_sizes().min()),
+                                     int(train.client_sizes().max())],
+            "curve": [(r, round(acc, 3)) for r, acc in evals],
+        }
+        logging.info("synthetic(%s,%s): best %.3f, first>60 round %s",
+                     a, b, best, first60)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=1)
+    if args.report:
+        _write_report(args.report, args, results)
+    return results
+
+
+def _write_report(path, args, results: dict) -> None:
+    from fedml_tpu.exp._report import update_section
+
+    rows = "\n".join(
+        f"| {name} | {r['best_test_acc'] * 100:.1f} | {r['first_round_over_60']} |"
+        for name, r in results.items()
+    )
+    curves = "\n".join(
+        f"- `{name}`: " + ", ".join(f"{rr}:{acc * 100:.1f}" for rr, acc in r["curve"])
+        for name, r in results.items()
+    )
+    update_section(path, "synthetic_ab", f"""# BASELINE reproduction — Synthetic(α,β) + LogisticRegression (Linear Models row 3)
+
+Reference target (BASELINE.md / benchmark/README.md:12-18): test acc **> 60**
+within **> 200 rounds** — 30 clients, 10/round, B=10, SGD lr=0.01, E=1, for
+(α,β) ∈ {{(0,0), (0.5,0.5), (1,1)}}.
+
+**Data:** the generator is fully specified math and this run matches the
+reference recipe end to end — W_k~N(u_k,1), u_k~N(0,α), B_k~N(0,β),
+x~N(v_k, Σ_jj=j^-1.2), AND the heavy-tailed per-client sample counts
+lognormal(4,2)+50 (data/synthetic_1_1/generate_synthetic.py). No fixture
+substitution was needed.
+
+| config | best test acc ({args.comm_round} rounds) | first round > 60 |
+|---|---|---|
+{rows}
+
+Accuracy curves (round:acc, eval every {args.frequency_of_the_test} rounds):
+
+{curves}
+
+Reproduce with: `python -m fedml_tpu.exp.repro_synthetic --report REPRO.md`
+""")
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument("--client_num_in_total", type=int, default=30)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=10)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--comm_round", type=int, default=250)
+    parser.add_argument("--frequency_of_the_test", type=int, default=25)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--out", type=str, default=None)
+    parser.add_argument("--report", type=str, default=None,
+                        help="REPRO.md path to update (marked section)")
+    return parser
+
+
+def main(argv=None):
+    args = add_args(argparse.ArgumentParser("synthetic baseline repro")).parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -53,3 +53,20 @@ def test_repro_full_scale(tmp_path):
     ])
     assert result["best_test_acc"] > 0.75, result
     assert result["first_round_over_75"] is not None
+
+
+@pytest.mark.slow
+def test_repro_synthetic_row():
+    from fedml_tpu.exp.repro_synthetic import main
+
+    results = main(["--comm_round", "100", "--frequency_of_the_test", "20"])
+    for name, r in results.items():
+        assert r["best_test_acc"] > 0.6, (name, r)
+
+
+def test_repro_synthetic_smoke():
+    from fedml_tpu.exp.repro_synthetic import main
+
+    results = main(["--comm_round", "30", "--frequency_of_the_test", "15"])
+    assert len(results) == 3
+    assert all(r["best_test_acc"] > 0.3 for r in results.values()), results
