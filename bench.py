@@ -149,6 +149,17 @@ def bench_resnet():
     sec_per_round_single = _measure_rounds(
         FedSim(trainer, train, test, cfg), n_meas=5, block=1
     )
+    # bf16 compute (f32 params): the TPU-first numerics for this model
+    import jax.numpy as jnp
+
+    trainer_bf16 = ClientTrainer(
+        module=resnet56(class_num=10, dtype=jnp.bfloat16),
+        optimizer=optax.sgd(0.1, momentum=0.9),
+        epochs=EPOCHS,
+    )
+    sec_per_round_bf16 = _measure_rounds(
+        FedSim(trainer_bf16, train, test, cfg), n_meas=3, block=10
+    )
 
     # pooled eval throughput (examples/sec): evaluate() runs the pooled train
     # set (n) plus the test set (n_eval) and returns host floats, so it is
@@ -160,7 +171,8 @@ def bench_resnet():
     for _ in range(n_meas):
         sim.evaluate(variables)
     eval_eps = (n + n_eval) * n_meas / (time.perf_counter() - t0)
-    return 1.0 / sec_per_round, 1.0 / sec_per_round_single, eval_eps
+    return (1.0 / sec_per_round, 1.0 / sec_per_round_single,
+            1.0 / sec_per_round_bf16, eval_eps)
 
 
 def bench_lm():
@@ -283,7 +295,7 @@ def main():
     device_kind = jax.devices()[0].device_kind
     peak = PEAK_TFLOPS.get(device_kind)
 
-    rounds_per_sec, rounds_per_sec_single, eval_eps = bench_resnet()
+    rounds_per_sec, rounds_per_sec_single, rounds_per_sec_bf16, eval_eps = bench_resnet()
     resnet_tflops = (
         resnet56_train_flops_per_image() * CLIENTS * STEPS * BATCH * EPOCHS
         * rounds_per_sec / 1e12
@@ -310,6 +322,7 @@ def main():
             "lm_delivered_tflops": round(lm_tflops, 2),
             "resnet_delivered_tflops": round(resnet_tflops, 2),
             "resnet_rounds_per_sec_single_dispatch": round(rounds_per_sec_single, 3),
+            "resnet_bf16_rounds_per_sec": round(rounds_per_sec_bf16, 3),
             "eval_examples_per_sec": round(eval_eps, 1),
         },
     }))

@@ -40,7 +40,10 @@ def synthetic_classification(
     ``lognormal(4, 2) + 50`` — the reference generator's heavy-tailed
     recipe (data/synthetic_1_1/generate_synthetic.py), used by the
     BASELINE reproduction; "uniform" draws from ``samples_per_client``
-    (compact shapes for tests).
+    (compact shapes for tests). Lognormal draws are capped at 10,000
+    samples/client (the unbounded tail would occasionally demand
+    million-sample clients); ~0.5% of draws clip. The caller can check
+    ``client_sizes()`` to see whether a given seed hit the cap.
     """
     rng = np.random.RandomState(seed)
     sigma = np.diag(np.asarray([(j + 1) ** -1.2 for j in range(dim)]))

@@ -21,11 +21,12 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 
-def _norm(kind: str, train: bool):
+def _norm(kind: str, train: bool, dtype=jnp.float32):
     if kind == "bn":
-        return partial(nn.BatchNorm, use_running_average=not train, momentum=0.9, epsilon=1e-5)
+        return partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=dtype)
     if kind == "gn":
-        return partial(nn.GroupNorm, num_groups=2)
+        return partial(nn.GroupNorm, num_groups=2, dtype=dtype)
     raise ValueError(f"unknown norm {kind!r}")
 
 
@@ -33,16 +34,18 @@ class BasicBlock(nn.Module):
     filters: int
     stride: int = 1
     norm: str = "bn"
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = _norm(self.norm, train)
-        y = nn.Conv(self.filters, (3, 3), strides=self.stride, padding="SAME", use_bias=False)(x)
+        norm = _norm(self.norm, train, self.dtype)
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        y = conv(self.filters, (3, 3), strides=self.stride, padding="SAME")(x)
         y = nn.relu(norm()(y))
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False)(y)
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
         y = norm()(y)
         if x.shape[-1] != self.filters or self.stride != 1:
-            x = nn.Conv(self.filters, (1, 1), strides=self.stride, use_bias=False)(x)
+            x = conv(self.filters, (1, 1), strides=self.stride)(x)
             x = norm()(x)
         return nn.relu(x + y)
 
@@ -53,19 +56,20 @@ class CifarResNet(nn.Module):
     depth: int = 56
     num_classes: int = 10
     norm: str = "bn"
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         n = (self.depth - 2) // 6
-        norm = _norm(self.norm, train)
+        norm = _norm(self.norm, train, self.dtype)
         x = x.astype(jnp.float32)
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False)(x)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm()(x))
         for stage, filters in enumerate([16, 32, 64]):
             for block in range(n):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm)(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))
+                x = BasicBlock(filters, stride, self.norm, self.dtype)(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
 
@@ -77,33 +81,37 @@ class ResNet18(nn.Module):
     num_classes: int = 100
     norm: str = "gn"
     small_input: bool = True
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        norm = _norm(self.norm, train)
+        norm = _norm(self.norm, train, self.dtype)
         x = x.astype(jnp.float32)
         if self.small_input:
-            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False)(x)
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
         else:
-            x = nn.Conv(64, (7, 7), strides=2, padding="SAME", use_bias=False)(x)
+            x = nn.Conv(64, (7, 7), strides=2, padding="SAME", use_bias=False, dtype=self.dtype)(x)
         x = nn.relu(norm()(x))
         if not self.small_input:
             x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
         for stage, filters in enumerate([64, 128, 256, 512]):
             for block in range(2):
                 stride = 2 if (stage > 0 and block == 0) else 1
-                x = BasicBlock(filters, stride, self.norm)(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))
+                x = BasicBlock(filters, stride, self.norm, self.dtype)(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
 
-def resnet56(class_num: int = 10, norm: str = "bn") -> CifarResNet:
-    return CifarResNet(depth=56, num_classes=class_num, norm=norm)
+def resnet56(class_num: int = 10, norm: str = "bn",
+             dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+    return CifarResNet(depth=56, num_classes=class_num, norm=norm, dtype=dtype)
 
 
-def resnet110(class_num: int = 10, norm: str = "bn") -> CifarResNet:
-    return CifarResNet(depth=110, num_classes=class_num, norm=norm)
+def resnet110(class_num: int = 10, norm: str = "bn",
+              dtype: jnp.dtype = jnp.float32) -> CifarResNet:
+    return CifarResNet(depth=110, num_classes=class_num, norm=norm, dtype=dtype)
 
 
-def resnet18_gn(class_num: int = 100) -> ResNet18:
-    return ResNet18(num_classes=class_num, norm="gn")
+def resnet18_gn(class_num: int = 100,
+                dtype: jnp.dtype = jnp.float32) -> ResNet18:
+    return ResNet18(num_classes=class_num, norm="gn", dtype=dtype)

@@ -67,6 +67,7 @@ def test_repro_synthetic_row():
 def test_repro_synthetic_smoke():
     from fedml_tpu.exp.repro_synthetic import main
 
-    results = main(["--comm_round", "30", "--frequency_of_the_test", "15"])
+    results = main(["--comm_round", "30", "--frequency_of_the_test", "15",
+                    "--size_dist", "uniform"])
     assert len(results) == 3
     assert all(r["best_test_acc"] > 0.3 for r in results.values()), results
