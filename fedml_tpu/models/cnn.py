@@ -25,17 +25,20 @@ class CNNOriginalFedAvg(nn.Module):
 
     num_classes: int = 62
     only_digits: bool = False
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = _ensure_nhwc(x)
-        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
-        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
         x = nn.max_pool(nn.relu(x), (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
-        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(10 if self.only_digits else self.num_classes)(
+            x.astype(jnp.float32)
+        )
 
 
 class CNNDropOut(nn.Module):
@@ -44,18 +47,21 @@ class CNNDropOut(nn.Module):
 
     num_classes: int = 62
     only_digits: bool = False
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = _ensure_nhwc(x)
-        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
-        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x))
         x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = nn.Dropout(0.25, deterministic=not train)(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(128)(x))
+        x = nn.relu(nn.Dense(128, dtype=self.dtype)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(
+            x.astype(jnp.float32)
+        )
 
 
 class LeNet(nn.Module):
@@ -66,15 +72,16 @@ class LeNet(nn.Module):
     is the aligned flat weight list (fedml_tpu/models/export.py)."""
 
     num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        h = nn.Conv(20, (5, 5), padding="VALID")(_ensure_nhwc(x))
+        h = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(_ensure_nhwc(x))
         h = nn.max_pool(h, (2, 2), strides=(2, 2))
         h = nn.relu(h)
-        h = nn.Conv(50, (5, 5), padding="VALID")(h)
+        h = nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype)(h)
         h = nn.max_pool(h, (2, 2), strides=(2, 2))
         h = nn.relu(h)
         h = h.reshape((h.shape[0], -1))
-        h = nn.relu(nn.Dense(500)(h))
-        return nn.Dense(self.num_classes)(h)
+        h = nn.relu(nn.Dense(500, dtype=self.dtype)(h))
+        return nn.Dense(self.num_classes)(h.astype(jnp.float32))

@@ -68,14 +68,15 @@ def _gn_groups(c: int, target: int = 8) -> int:
 class SqueezeExcite(nn.Module):
     features: int
     se_ratio: float = 0.25
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         squeezed = max(1, int(self.features * self.se_ratio))
         s = jnp.mean(x, axis=(1, 2), keepdims=True)
-        s = nn.Conv(squeezed, (1, 1))(s)
+        s = nn.Conv(squeezed, (1, 1), dtype=self.dtype)(s)
         s = nn.silu(s)
-        s = nn.Conv(x.shape[-1], (1, 1))(s)
+        s = nn.Conv(x.shape[-1], (1, 1), dtype=self.dtype)(s)
         return x * nn.sigmoid(s)
 
 
@@ -85,24 +86,28 @@ class MBConv(nn.Module):
     stride: int
     kernel: int
     drop_rate: float = 0.0
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         inp = x.shape[-1]
         h = x
         if self.expand_ratio != 1:
-            h = nn.Conv(inp * self.expand_ratio, (1, 1), use_bias=False)(h)
-            h = nn.GroupNorm(num_groups=_gn_groups(inp * self.expand_ratio))(h)
+            h = nn.Conv(inp * self.expand_ratio, (1, 1), use_bias=False,
+                        dtype=self.dtype)(h)
+            h = nn.GroupNorm(num_groups=_gn_groups(inp * self.expand_ratio),
+                             dtype=self.dtype)(h)
             h = nn.silu(h)
         # depthwise
         c = h.shape[-1]
         h = nn.Conv(c, (self.kernel, self.kernel), strides=self.stride,
-                    padding="SAME", feature_group_count=c, use_bias=False)(h)
-        h = nn.GroupNorm(num_groups=_gn_groups(c))(h)
+                    padding="SAME", feature_group_count=c, use_bias=False,
+                    dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(c), dtype=self.dtype)(h)
         h = nn.silu(h)
-        h = SqueezeExcite(inp)(h)
-        h = nn.Conv(self.out_features, (1, 1), use_bias=False)(h)
-        h = nn.GroupNorm(num_groups=_gn_groups(self.out_features))(h)
+        h = SqueezeExcite(inp, dtype=self.dtype)(h)
+        h = nn.Conv(self.out_features, (1, 1), use_bias=False, dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(self.out_features), dtype=self.dtype)(h)
         if self.stride == 1 and inp == self.out_features:
             if self.drop_rate > 0.0 and train:
                 # stochastic depth on the residual branch
@@ -121,12 +126,13 @@ class EfficientNet(nn.Module):
     dropout_rate: float = 0.2
     drop_connect_rate: float = 0.2
     stem_features: int = 32
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h = nn.Conv(round_filters(self.stem_features, self.width), (3, 3),
-                    strides=2, padding="SAME", use_bias=False)(x)
-        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]))(h)
+                    strides=2, padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]), dtype=self.dtype)(h)
         h = nn.silu(h)
 
         total_blocks = sum(round_repeats(r, self.depth) for _, _, r, _, _ in BASE_BLOCKS)
@@ -140,20 +146,24 @@ class EfficientNet(nn.Module):
                     stride=stride if i == 0 else 1,
                     kernel=kernel,
                     drop_rate=self.drop_connect_rate * block_idx / total_blocks,
+                    dtype=self.dtype,
                 )(h, train=train)
                 block_idx += 1
 
-        h = nn.Conv(round_filters(1280, self.width), (1, 1), use_bias=False)(h)
-        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]))(h)
+        h = nn.Conv(round_filters(1280, self.width), (1, 1), use_bias=False,
+                    dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=_gn_groups(h.shape[-1]), dtype=self.dtype)(h)
         h = nn.silu(h)
-        h = jnp.mean(h, axis=(1, 2))
+        h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))
         if self.dropout_rate:
             h = nn.Dropout(self.dropout_rate, deterministic=not train)(h)
         return nn.Dense(self.num_classes)(h)
 
 
-def efficientnet(name: str = "efficientnet-b0", num_classes: int = 10) -> EfficientNet:
+def efficientnet(name: str = "efficientnet-b0", num_classes: int = 10,
+                 dtype: jnp.dtype = jnp.float32) -> EfficientNet:
     """Factory matching the reference's ``EfficientNet.from_name`` dispatch."""
     width, depth, _res, dropout = SCALING[name]
     return EfficientNet(num_classes=num_classes, width=width, depth=depth,
+                        dtype=dtype,
                         dropout_rate=dropout)

@@ -17,15 +17,16 @@ import jax.numpy as jnp
 class DepthwiseSeparable(nn.Module):
     filters: int
     stride: int = 1
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         in_ch = x.shape[-1]
         x = nn.Conv(in_ch, (3, 3), strides=self.stride, padding="SAME",
-                    feature_group_count=in_ch, use_bias=False)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
-        x = nn.Conv(self.filters, (1, 1), use_bias=False)(x)
-        return nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+                    feature_group_count=in_ch, use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        return nn.relu(nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
 
 
 class MobileNet(nn.Module):
@@ -33,18 +34,20 @@ class MobileNet(nn.Module):
 
     num_classes: int = 10
     small_input: bool = True
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(jnp.float32)
         stem_stride = 1 if self.small_input else 2
-        x = nn.Conv(32, (3, 3), strides=stem_stride, padding="SAME", use_bias=False)(x)
-        x = nn.relu(nn.BatchNorm(use_running_average=not train)(x))
+        x = nn.Conv(32, (3, 3), strides=stem_stride, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
         cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
                (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
         for filters, stride in cfg:
-            x = DepthwiseSeparable(filters, stride)(x, train=train)
-        x = jnp.mean(x, axis=(1, 2))
+            x = DepthwiseSeparable(filters, stride, self.dtype)(x, train=train)
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         return nn.Dense(self.num_classes)(x)
 
 
@@ -58,13 +61,14 @@ def _hard_swish(x):
 
 class SqueezeExcite(nn.Module):
     reduce: int = 4
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         ch = x.shape[-1]
         s = jnp.mean(x, axis=(1, 2))
-        s = nn.relu(nn.Dense(max(ch // self.reduce, 8))(s))
-        s = _hard_sigmoid(nn.Dense(ch)(s))
+        s = nn.relu(nn.Dense(max(ch // self.reduce, 8), dtype=self.dtype)(s))
+        s = _hard_sigmoid(nn.Dense(ch, dtype=self.dtype)(s))
         return x * s[:, None, None, :]
 
 
@@ -75,22 +79,25 @@ class InvertedResidual(nn.Module):
     stride: int
     use_se: bool
     use_hs: bool
+    dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         act = _hard_swish if self.use_hs else nn.relu
+        bn = lambda: nn.BatchNorm(use_running_average=not train, dtype=self.dtype)  # noqa: E731
         inp = x.shape[-1]
         y = x
         if self.expand != inp:
-            y = nn.Conv(self.expand, (1, 1), use_bias=False)(y)
-            y = act(nn.BatchNorm(use_running_average=not train)(y))
+            y = nn.Conv(self.expand, (1, 1), use_bias=False, dtype=self.dtype)(y)
+            y = act(bn()(y))
         y = nn.Conv(self.expand, (self.kernel, self.kernel), strides=self.stride,
-                    padding="SAME", feature_group_count=self.expand, use_bias=False)(y)
-        y = act(nn.BatchNorm(use_running_average=not train)(y))
+                    padding="SAME", feature_group_count=self.expand,
+                    use_bias=False, dtype=self.dtype)(y)
+        y = act(bn()(y))
         if self.use_se:
-            y = SqueezeExcite()(y)
-        y = nn.Conv(self.filters, (1, 1), use_bias=False)(y)
-        y = nn.BatchNorm(use_running_average=not train)(y)
+            y = SqueezeExcite(dtype=self.dtype)(y)
+        y = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        y = bn()(y)
         if self.stride == 1 and inp == self.filters:
             y = y + x
         return y
@@ -121,19 +128,21 @@ class MobileNetV3(nn.Module):
     num_classes: int = 10
     mode: str = "small"
     small_input: bool = True
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(jnp.float32)
         cfg = _V3_SMALL if self.mode == "small" else _V3_LARGE
         stem_stride = 1 if self.small_input else 2
-        x = nn.Conv(16, (3, 3), strides=stem_stride, padding="SAME", use_bias=False)(x)
-        x = _hard_swish(nn.BatchNorm(use_running_average=not train)(x))
+        x = nn.Conv(16, (3, 3), strides=stem_stride, padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = _hard_swish(nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
         for block_cfg in cfg:
-            x = InvertedResidual(*block_cfg)(x, train=train)
+            x = InvertedResidual(*block_cfg, dtype=self.dtype)(x, train=train)
         head = 576 if self.mode == "small" else 960
-        x = nn.Conv(head, (1, 1), use_bias=False)(x)
-        x = _hard_swish(nn.BatchNorm(use_running_average=not train)(x))
-        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Conv(head, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = _hard_swish(nn.BatchNorm(use_running_average=not train, dtype=self.dtype)(x))
+        x = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         x = _hard_swish(nn.Dense(1280 if self.mode == "large" else 1024)(x))
         return nn.Dense(self.num_classes)(x)

@@ -20,6 +20,7 @@ class VGG(nn.Module):
     depth: int = 16
     num_classes: int = 10
     batch_norm: bool = True
+    dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params f32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -28,11 +29,13 @@ class VGG(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(int(v), (3, 3), padding="SAME", use_bias=not self.batch_norm)(x)
+                x = nn.Conv(int(v), (3, 3), padding="SAME",
+                            use_bias=not self.batch_norm, dtype=self.dtype)(x)
                 if self.batch_norm:
-                    x = nn.BatchNorm(use_running_average=not train)(x)
+                    x = nn.BatchNorm(use_running_average=not train,
+                                     dtype=self.dtype)(x)
                 x = nn.relu(x)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(512)(x))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        return nn.Dense(self.num_classes)(x)
+        return nn.Dense(self.num_classes)(x.astype(jnp.float32))

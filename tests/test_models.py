@@ -224,3 +224,39 @@ def test_darts_gdas_samples_single_op():
     # eval path is deterministic (argmax ops, no rng needed)
     out_eval = net.apply(v, x, train=False)
     assert out_eval.shape == (2, 4)
+
+
+def test_cv_zoo_bf16_compute():
+    """Every CV-zoo model takes a compute dtype: bf16 forward works, params
+    stay f32, logits come back f32."""
+    import numpy as np
+
+    from fedml_tpu.models.cnn import CNNDropOut, CNNOriginalFedAvg, LeNet
+    from fedml_tpu.models.efficientnet import EfficientNet
+    from fedml_tpu.models.mobilenet import MobileNet, MobileNetV3
+    from fedml_tpu.models.resnet import resnet18_gn, resnet56
+    from fedml_tpu.models.vgg import VGG
+
+    cases = [
+        (CNNOriginalFedAvg(num_classes=4, dtype=jnp.bfloat16), (2, 28, 28, 1)),
+        (CNNDropOut(num_classes=4, dtype=jnp.bfloat16), (2, 28, 28, 1)),
+        (LeNet(num_classes=4, dtype=jnp.bfloat16), (2, 28, 28, 1)),
+        (resnet56(4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+        (resnet18_gn(4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+        (MobileNet(num_classes=4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+        (MobileNetV3(num_classes=4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+        (VGG(depth=11, num_classes=4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+        (EfficientNet(num_classes=4, dtype=jnp.bfloat16), (2, 32, 32, 3)),
+    ]
+    for model, shape in cases:
+        x = jnp.ones(shape, jnp.float32)
+        v = model.init({"params": jax.random.key(0), "dropout": jax.random.key(1)},
+                       x, train=False)
+        out = model.apply(v, x, train=False)
+        assert out.shape == (2, 4), type(model).__name__
+        assert out.dtype == jnp.float32, type(model).__name__
+        assert all(
+            l.dtype == jnp.float32
+            for l in jax.tree.leaves(v["params"])
+        ), type(model).__name__
+        assert np.isfinite(np.asarray(out)).all(), type(model).__name__
