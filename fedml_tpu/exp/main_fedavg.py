@@ -67,6 +67,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--stddev", type=float, default=0.0)
     parser.add_argument("--robust_rule", type=str, default="mean")
     # engine knobs
+    parser.add_argument("--model_dtype", type=str, default="float32",
+                        choices=["float32", "bfloat16"],
+                        help="compute dtype for models that support one "
+                             "(CV zoo, transformer); params stay float32")
     parser.add_argument("--augment", type=int, default=0,
                         help="on-device crop/flip/cutout train augmentation "
                              "(the reference's CIFAR-family torchvision "
@@ -241,7 +245,8 @@ def run(args) -> list[dict]:
         args.dataset, args.data_dir, args.partition_method, args.partition_alpha,
         args.client_num_in_total, args.seed,
     )
-    model = create_model(args.model, ds.class_num, args.dataset)
+    model = create_model(args.model, ds.class_num, args.dataset,
+                         dtype=getattr(args, "model_dtype", None))
     trainer = build_trainer(args, model, args.dataset)
     aggregator = build_aggregator(args, ds.train)
 

@@ -16,10 +16,33 @@ from fedml_tpu.models.transformer import TransformerLM
 from fedml_tpu.models.vgg import VGG
 
 
-def create_model(model_name: str, output_dim: int, dataset: str = "") -> Any:
+def create_model(model_name: str, output_dim: int, dataset: str = "",
+                 dtype: Any = None) -> Any:
     """Reference name/dataset dispatch (main_fedavg.py:354-390). Returns the
     Flax module; task selection (classification/nwp/tag) is the trainer's job
-    as in the reference (FedAvgAPI.py:85-91)."""
+    as in the reference (FedAvgAPI.py:85-91).
+
+    ``dtype`` (jnp dtype or string like "bfloat16") selects the compute
+    dtype for models that support one (the CV zoo + TransformerLM); models
+    without a dtype field raise a clear error rather than silently ignoring
+    the request."""
+    model = _create(model_name, output_dim, dataset)
+    if dtype is not None and str(dtype) != "float32":
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        if isinstance(dtype, str):
+            dtype = jnp.dtype(dtype).type
+        if not any(f.name == "dtype" for f in dataclasses.fields(model)):
+            raise ValueError(
+                f"model {model_name!r} does not take a compute dtype"
+            )
+        model = model.clone(dtype=dtype)
+    return model
+
+
+def _create(model_name: str, output_dim: int, dataset: str = "") -> Any:
     if model_name == "lr" and dataset == "stackoverflow_lr":
         return LogisticRegression(num_classes=output_dim)  # 10004-dim input handled by data
     if model_name == "lr":

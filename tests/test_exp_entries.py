@@ -85,3 +85,16 @@ def test_cli_backend_message_passing(backend, tmp_path):
     ])
     assert final["round"] == 2
     assert final["Test/Acc"] > 0.5
+
+
+def test_model_dtype_flag():
+    import jax.numpy as jnp
+    import pytest
+
+    from fedml_tpu.models.registry import create_model
+
+    m = create_model("resnet56", 10, "cifar10", dtype=jnp.bfloat16)
+    assert m.dtype == jnp.bfloat16
+    # models without a dtype field error loudly instead of silently ignoring
+    with pytest.raises(ValueError, match="does not take a compute dtype"):
+        create_model("lr", 10, "mnist", dtype=jnp.bfloat16)
