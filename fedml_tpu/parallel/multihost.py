@@ -70,12 +70,6 @@ def init_multihost(
     )
 
 
-def is_multihost() -> bool:
-    import jax
-
-    return jax.process_count() > 1
-
-
 def global_client_mesh(silo: int = 1):
     """A mesh over every device in the job (all hosts), clients x silo —
     the multi-host version of parallel.mesh.client_mesh/silo_mesh (same

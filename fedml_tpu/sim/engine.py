@@ -553,11 +553,6 @@ class FedSim:
             round_idx, cfg.client_num_in_total, cfg.client_num_per_round
         )
 
-    def stage_round(self, round_idx: int):
-        """Sample the round's cohort and stage its data on device."""
-        cohort = self._sample_round_cohort(round_idx)
-        return (cohort, *self.stage_cohort(cohort, round_idx))
-
     def run_round(self, round_idx, global_variables, server_state, root_rng):
         rkey = rnglib.round_key(root_rng, round_idx)
         cohort = self._sample_round_cohort(round_idx)
