@@ -99,20 +99,37 @@ def test_two_process_fedavg_matches_single_process(tmp_path):
     np.testing.assert_allclose(a["flat"], flat, rtol=1e-5, atol=1e-6)
 
 
-@pytest.mark.slow
-def test_multihost_cli_entry(tmp_path):
-    """The main_multihost experiment entry: 2 CLI processes, identical
-    final models."""
+def _run_cli_pair(tmp_path, local_device_count: int, extra_args: list[str]):
+    """Launch two main_multihost CLI processes and return their npz outputs."""
     port = _free_port()
     outs = [tmp_path / f"cli{i}.npz" for i in range(2)]
     _run_procs([
         [sys.executable, "-m", "fedml_tpu.exp.main_multihost",
          "--coordinator", f"localhost:{port}",
          "--num_processes", "2", "--process_id", str(i),
-         "--local_device_count", "2", "--platform", "cpu",
+         "--local_device_count", str(local_device_count), "--platform", "cpu",
          "--comm_round", "3", "--frequency_of_the_test", "3",
-         "--out", str(outs[i])]
+         "--out", str(outs[i])] + extra_args
         for i in range(2)
     ])
-    a, b = np.load(outs[0]), np.load(outs[1])
+    return np.load(outs[0]), np.load(outs[1])
+
+
+@pytest.mark.slow
+def test_multihost_cli_entry(tmp_path):
+    """The main_multihost experiment entry: 2 CLI processes, identical
+    final models."""
+    a, b = _run_cli_pair(tmp_path, 2, [])
     np.testing.assert_allclose(a["flat"], b["flat"], rtol=1e-6)
+
+
+@pytest.mark.slow
+def test_multihost_silo_mesh(tmp_path):
+    """2-D clients x silo global mesh spanning processes: 2 procs x 4
+    devices = mesh {clients: 4, silo: 2}, both controllers agree."""
+    a, b = _run_cli_pair(tmp_path, 4, [
+        "--silo", "2", "--client_num_in_total", "8",
+        "--client_num_per_round", "4",
+    ])
+    np.testing.assert_allclose(a["flat"], b["flat"], rtol=1e-6)
+    assert a["Test_Acc"] == b["Test_Acc"]
