@@ -74,14 +74,12 @@ class HierarchicalFedAvg:
                 # private copy so the global model survives all groups.
                 gvars = jax.tree.map(jnp.copy, variables)
                 for _ in range(hier.group_comm_round):
-                    # shared staging: straggler budgets, padding, sharding all
-                    # behave identically to the flat engine
-                    batches, weights, num_steps = sim.stage_cohort(
-                        client_ids, round_counter
-                    )
+                    # shared staging + dispatch: straggler budgets, padding,
+                    # sharding, and the on-device index-map path all behave
+                    # identically to the flat engine
                     rkey = rnglib.round_key(root, round_counter)
-                    gvars, server_state, _ = sim._round_fn(
-                        gvars, server_state, batches, weights, num_steps, rkey
+                    gvars, server_state, _ = sim.run_cohort_round(
+                        client_ids, round_counter, gvars, server_state, rkey
                     )
                     round_counter += 1
                 group_models.append(gvars)

@@ -553,9 +553,11 @@ class FedSim:
             round_idx, cfg.client_num_in_total, cfg.client_num_per_round
         )
 
-    def run_round(self, round_idx, global_variables, server_state, root_rng):
-        rkey = rnglib.round_key(root_rng, round_idx)
-        cohort = self._sample_round_cohort(round_idx)
+    def run_cohort_round(self, cohort, round_idx, global_variables,
+                         server_state, rkey):
+        """One round over an explicit cohort: stage (on-device index map or
+        host batches) and dispatch. Shared by run_round and compositions
+        that pick their own cohorts (HierarchicalFedAvg's groups)."""
         if self._on_device:
             idx, weights, num_steps = self.stage_cohort_indices(cohort, round_idx)
             return self._gather_round_fn(
@@ -565,6 +567,13 @@ class FedSim:
         batches, weights, num_steps = self.stage_cohort(cohort, round_idx)
         return self._round_fn(
             global_variables, server_state, batches, weights, num_steps, rkey
+        )
+
+    def run_round(self, round_idx, global_variables, server_state, root_rng):
+        rkey = rnglib.round_key(root_rng, round_idx)
+        cohort = self._sample_round_cohort(round_idx)
+        return self.run_cohort_round(
+            cohort, round_idx, global_variables, server_state, rkey
         )
 
     def evaluate_per_client(
