@@ -15,6 +15,29 @@ from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
 from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
 
 
+
+def _free_port_run(n: int = 1) -> int:
+    """Base of a run of ``n`` consecutive free ports (all probed)."""
+    import socket
+
+    for _ in range(64):
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            base = s.getsockname()[1]
+        if base + n >= 65535:
+            continue
+        ok = True
+        for p in range(base, base + n):
+            try:
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", p))
+            except OSError:
+                ok = False
+                break
+        if ok:
+            return base
+    raise RuntimeError("no consecutive free-port run found")
+
 def test_message_wire_roundtrip():
     m = Message(msg_type=2, sender_id=0, receiver_id=3)
     m.add_params("model_params", np.arange(12, dtype=np.float32).reshape(3, 4))
@@ -173,16 +196,8 @@ def test_grpc_backend_roundtrip():
     grpc = pytest.importorskip("grpc")
     from fedml_tpu.comm.grpc_backend import GRPCCommManager
 
-    import socket
-
-    def free_port():
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        p = s.getsockname()[1]
-        s.close()
-        return p
-
-    cfg = {0: ("127.0.0.1", free_port()), 1: ("127.0.0.1", free_port())}
+    base = _free_port_run(2)
+    cfg = {0: ("127.0.0.1", base), 1: ("127.0.0.1", base + 1)}
     a = GRPCCommManager(0, cfg)
     b = GRPCCommManager(1, cfg)
     got = []
@@ -317,3 +332,25 @@ def test_mqtt_backend_gated():
 
     with pytest.raises(ImportError, match="paho-mqtt"):
         MqttCommManager("localhost", 1883)
+
+
+def test_distributed_fedavg_grpc_runner():
+    """The grpc runner wrapper end-to-end on localhost ports (this path had
+    an import typo that only a test can keep dead)."""
+    pytest.importorskip("grpc")
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg_grpc
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    base = _free_port_run(3)  # the runner binds base..base+worker_num
+    train, _ = gaussian_blobs(n_clients=2, samples_per_client=20, num_classes=4, seed=3)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2), epochs=1
+    )
+    final = run_distributed_fedavg_grpc(
+        trainer, train, worker_num=2, round_num=2, batch_size=8,
+        seed=0, base_port=base,
+    )
+    flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(final)])
+    assert np.isfinite(flat).all()
