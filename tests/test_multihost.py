@@ -41,6 +41,8 @@ def _run_procs(argvs, timeout=300):
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # workers set their own device count
     env["JAX_PLATFORMS"] = "cpu"
+    # worker scripts get sys.path[0] = tests/, not the repo root
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
     procs = [
         subprocess.Popen(
             argv, env=env, cwd=str(REPO),
