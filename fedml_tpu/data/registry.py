@@ -71,6 +71,17 @@ def _batches(arrays, batch_size):
     return out
 
 
+# every name load_partition_data dispatches on ("synthetic" matches by
+# prefix); tests/test_data.py::test_known_datasets_matches_dispatch keeps
+# this in sync with the dispatch source
+KNOWN_DATASETS = (
+    "cifar10", "cifar100", "cinic10", "mnist", "shakespeare",
+    "fed_shakespeare", "femnist", "fed_cifar100", "stackoverflow_nwp",
+    "stackoverflow_lr", "ILSVRC2012", "ILSVRC2012_hdf5", "imagenet",
+    "gld23k", "gld160k", "landmarks", "synthetic",
+)
+
+
 def load_partition_data(
     dataset: str,
     data_dir: str | None = None,

@@ -25,6 +25,9 @@ import numpy as np
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     # canonical reference flag set (main_fedavg.py:46-130)
+    parser.add_argument("--cf", "--config_file", dest="cf", type=str, default=None,
+                        help="YAML config file; keys are the flag names below "
+                             "(CLI flags override file values)")
     parser.add_argument("--model", type=str, default="lr")
     parser.add_argument("--dataset", type=str, default="mnist")
     parser.add_argument("--data_dir", type=str, default=None)
@@ -376,9 +379,58 @@ def run(args) -> list[dict]:
     return history
 
 
+def parse_with_config(parser: argparse.ArgumentParser, argv=None):
+    """Parse argv, honoring ``--cf config.yaml`` (the north-star "unchanged
+    YAML configs" entry shape; reference passes YAML for GPU mapping and
+    credentials, fed_launch/main.py:357). File keys are flag names; explicit
+    CLI flags override file values; unknown keys fail loudly."""
+    args = parser.parse_args(argv)
+    if not args.cf:
+        return args
+    import yaml
+
+    with open(args.cf) as f:
+        conf = yaml.safe_load(f) or {}
+    if not isinstance(conf, dict):
+        raise ValueError(f"--cf {args.cf}: top level must be a mapping")
+    actions = {a.dest: a for a in parser._actions}
+    known = set(vars(args)) - {"cf"}  # no config chaining: cf-in-cf is an error
+    unknown = sorted(set(conf) - known)
+    if unknown:
+        raise ValueError(f"--cf {args.cf}: unknown keys {unknown}")
+    coerced = {}
+    for key, val in conf.items():
+        a = actions[key]
+        # apply the type coercion + choices validation the CLI path gets
+        # (YAML reads "1e-3" as a string, set_defaults alone would smuggle
+        # it past type=float)
+        if val is None:
+            if a.default is not None:
+                raise ValueError(
+                    f"--cf {args.cf}: key {key} has no value "
+                    f"(flag default is {a.default!r})"
+                )
+        elif a.type is not None:
+            if a.type is int and isinstance(val, float) and int(val) != val:
+                raise ValueError(
+                    f"--cf {args.cf}: key {key}: {val!r} is not an integer"
+                )
+            try:
+                val = a.type(val)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"--cf {args.cf}: key {key}: {e}") from None
+        if a.choices is not None and val not in a.choices:
+            raise ValueError(
+                f"--cf {args.cf}: key {key}: {val!r} not in {sorted(a.choices)}"
+            )
+        coerced[key] = val
+    parser.set_defaults(**coerced)
+    return parser.parse_args(argv)  # CLI flags still win over file values
+
+
 def main(argv=None):
     parser = add_args(argparse.ArgumentParser("fedml_tpu unified entry"))
-    args = parser.parse_args(argv)
+    args = parse_with_config(parser, argv)
     history = run(args)
     final = history[-1] if history else {}
     logging.info("final: %s", final)

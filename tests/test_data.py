@@ -81,3 +81,23 @@ def test_tag_fixture():
 def test_unknown_dataset():
     with pytest.raises(ValueError):
         load_partition_data("nope")
+
+
+def test_known_datasets_matches_dispatch():
+    """KNOWN_DATASETS must list exactly the names load_partition_data
+    dispatches on (string literals compared against ``dataset`` in the
+    source, plus the synthetic prefix family)."""
+    import inspect
+    import re
+
+    from fedml_tpu.data import registry
+
+    src = inspect.getsource(registry.load_partition_data)
+    dispatched = set()
+    dispatched.update(re.findall(r'dataset == "([^"]+)"', src))
+    dispatched.update(re.findall(r'dataset\.startswith\("([^"]+)"\)', src))
+    for group in re.findall(r'dataset in \(([^)]*)\)', src):
+        dispatched.update(re.findall(r'"([^"]+)"', group))
+    assert dispatched == set(registry.KNOWN_DATASETS), (
+        sorted(dispatched ^ set(registry.KNOWN_DATASETS))
+    )
