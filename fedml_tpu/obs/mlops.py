@@ -19,6 +19,7 @@ mutable state was a reference defect, not a feature.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import time
@@ -27,7 +28,7 @@ from typing import Any, Protocol
 
 from fedml_tpu.obs.sysstats import SysStats
 
-# reference topic names (mlops_logger.py:32-110), kept verbatim
+# reference topic names (mlops_logger.py:32-110, FedEventSDK.py:72), verbatim
 TOPIC_CLIENT_STATUS = "fl_client/mlops/status"
 TOPIC_CLIENT_ID_STATUS = "fl_client/mlops/{edge_id}/status"
 TOPIC_SERVER_STATUS = "fl_server/mlops/status"
@@ -38,6 +39,8 @@ TOPIC_ROUND_INFO = "fl_client/mlops/training_roundx"
 TOPIC_CLIENT_MODEL = "fl_server/mlops/client_model"
 TOPIC_AGGREGATED_MODEL = "fl_server/mlops/global_aggregated_model"
 TOPIC_SYSTEM = "fl_client/mlops/system_performance"
+TOPIC_EVENTS = "/mlops/events"
+TOPIC_LOGS = "/mlops/logs"
 
 
 class Messenger(Protocol):
@@ -152,3 +155,111 @@ class MLOpsLogger:
             )
 
         return cb
+
+
+class FedEvents:
+    """Start/end event spans on the reference's ``/mlops/events`` topic with
+    its exact payload keys (FedEventSDK.py:37-81). The reference's singleton
+    and hardcoded MqttS3 transport are dropped: one instance per run over any
+    :class:`Messenger`."""
+
+    def __init__(self, messenger: Messenger, run_id: Any = None, edge_id: Any = 0):
+        self.messenger = messenger
+        self.run_id = run_id
+        self.edge_id = edge_id
+
+    def _send(self, msg: dict) -> None:
+        self.messenger.send_message_json(TOPIC_EVENTS, json.dumps(msg))
+
+    def log_event_started(self, event_name, event_value=None, event_edge_id=None):
+        self._send({
+            "run_id": self.run_id,
+            "edge_id": self.edge_id if event_edge_id is None else event_edge_id,
+            "event_name": event_name,
+            "event_value": "" if event_value is None else event_value,
+            "started_time": int(time.time()),
+        })
+
+    def log_event_ended(self, event_name, event_value=None, event_edge_id=None):
+        self._send({
+            "run_id": self.run_id,
+            "edge_id": self.edge_id if event_edge_id is None else event_edge_id,
+            "event_name": event_name,
+            "event_value": "" if event_value is None else event_value,
+            "ended_time": int(time.time()),
+        })
+
+    @contextlib.contextmanager
+    def span(self, event_name, event_value=None):
+        """Context manager emitting a paired started/ended event."""
+        self.log_event_started(event_name, event_value)
+        try:
+            yield
+        finally:
+            self.log_event_ended(event_name, event_value)
+
+
+class FedLogs:
+    """Incremental log shipper (FedLogsSDK.py:97-139 role): tails a run's
+    log file and publishes batches of new lines with the reference's upload
+    payload keys. The reference POSTs to open.fedml.ai in a background
+    process and tracks its offset in log-config.yaml; here upload is an
+    explicit ``upload_once()`` the caller schedules (cron thread, round
+    callback, or atexit), the offset lives on the instance, and the sink is
+    any :class:`Messenger` on ``/mlops/logs``."""
+
+    LOG_LINES_PER_UPLOAD = 100
+    MAX_BYTES_PER_READ = 8 << 20  # backlog is shipped in bounded chunks
+
+    def __init__(self, log_file_path: str | Path, messenger: Messenger,
+                 run_id: Any = None, edge_id: Any = 0):
+        self.log_file_path = Path(log_file_path)
+        self.messenger = messenger
+        self.run_id = run_id
+        self.edge_id = edge_id
+        self._offset = 0  # byte offset of the first unshipped line
+        self._ino = None  # inode of the file the offset refers to
+
+    def upload_once(self) -> int:
+        """Ship all new complete lines since the last call; returns lines
+        shipped. Reads from a byte offset in bounded chunks (never the whole
+        backlog at once) and holds back a trailing partial line until its
+        newline arrives, so tailing a live log neither truncates records nor
+        rereads history. A rotated file (new inode) or one that shrank
+        (copytruncate / reopen with mode "w") restarts from byte 0 rather
+        than silently going quiet."""
+        import os
+
+        if not self.log_file_path.exists():
+            return 0
+        shipped = 0
+        with open(self.log_file_path, "rb") as f:
+            st = os.fstat(f.fileno())
+            if st.st_ino != self._ino or st.st_size < self._offset:
+                self._offset = 0
+            self._ino = st.st_ino
+            f.seek(self._offset)
+            while True:
+                data = f.read(self.MAX_BYTES_PER_READ)
+                end = data.rfind(b"\n") + 1
+                if end == 0:
+                    break
+                self._offset += end
+                lines = data[:end].decode(errors="replace").splitlines(keepends=True)
+                for start in range(0, len(lines), self.LOG_LINES_PER_UPLOAD):
+                    batch = lines[start:start + self.LOG_LINES_PER_UPLOAD]
+                    now = time.time()
+                    self.messenger.send_message_json(TOPIC_LOGS, json.dumps({
+                        "run_id": self.run_id,
+                        "edge_id": self.edge_id,
+                        "logs": batch,
+                        "create_time": now,
+                        "update_time": now,
+                        "created_by": str(self.edge_id),
+                        "updated_by": str(self.edge_id),
+                    }))
+                    shipped += len(batch)
+                if len(data) < self.MAX_BYTES_PER_READ:
+                    break
+                f.seek(self._offset)  # re-read the held-back partial tail
+        return shipped

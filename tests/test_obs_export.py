@@ -156,3 +156,91 @@ def test_stablehlo_export_roundtrip(tmp_path):
     loaded = load_stablehlo(path)
     out = loaded.call(v, x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(fwd(v, x)), rtol=1e-6)
+
+
+def test_fed_events_span(tmp_path):
+    """FedEvents publishes the reference /mlops/events payloads
+    (FedEventSDK.py:70-81): started_time on start, ended_time on end."""
+    import json
+
+    from fedml_tpu.obs.mlops import FedEvents, FileMessenger
+
+    sink = tmp_path / "events.jsonl"
+    ev = FedEvents(FileMessenger(sink), run_id="r1", edge_id=2)
+    with ev.span("aggregate", event_value="round3"):
+        pass
+    ev.log_event_started("train", event_edge_id=7)
+
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [r["topic"] for r in recs] == ["/mlops/events"] * 3
+    start, end, other = (r["payload"] for r in recs)
+    assert start["event_name"] == "aggregate" and "started_time" in start
+    assert end["event_name"] == "aggregate" and "ended_time" in end
+    assert start["run_id"] == "r1" and start["edge_id"] == 2
+    assert other["edge_id"] == 7  # explicit edge id override
+
+
+def test_fed_logs_incremental_upload(tmp_path):
+    """FedLogs ships only new lines on each call, batched at
+    LOG_LINES_PER_UPLOAD with the reference upload keys (FedLogsSDK.py:102)."""
+    import json
+
+    from fedml_tpu.obs.mlops import FedLogs, FileMessenger
+
+    log = tmp_path / "run.log"
+    sink = tmp_path / "logs.jsonl"
+    shipper = FedLogs(log, FileMessenger(sink), run_id=9, edge_id=1)
+
+    assert shipper.upload_once() == 0  # file not there yet
+
+    log.write_text("".join(f"line{i}\n" for i in range(250)))
+    assert shipper.upload_once() == 250
+    with log.open("a") as f:
+        f.write("line250")  # partial line: held back until the newline lands
+    assert shipper.upload_once() == 0
+    with log.open("a") as f:
+        f.write(" done\nline251\n")
+    assert shipper.upload_once() == 2
+    assert shipper.upload_once() == 0
+
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert [len(r["payload"]["logs"]) for r in recs] == [100, 100, 50, 2]
+    p = recs[0]["payload"]
+    assert {"run_id", "edge_id", "logs", "create_time", "update_time",
+            "created_by", "updated_by"} <= set(p)
+    assert recs[-1]["payload"]["logs"] == ["line250 done\n", "line251\n"]
+
+    # in-place truncation (copytruncate): restarts at byte 0, never goes quiet
+    log.write_text("fresh\n")
+    assert shipper.upload_once() == 1
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert recs[-1]["payload"]["logs"] == ["fresh\n"]
+
+    # rotation to a NEW file that grows past the old offset before the next
+    # call: the inode check catches it, nothing from the new file is dropped
+    big = "".join(f"rotated{i}\n" for i in range(80))
+    assert len(big) > shipper._offset
+    log.rename(log.with_suffix(".1"))
+    log.write_text(big)
+    assert shipper.upload_once() == 80
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    assert recs[-1]["payload"]["logs"][0] == "rotated0\n"
+
+
+def test_fed_logs_chunked_backlog(tmp_path):
+    """A backlog larger than MAX_BYTES_PER_READ ships completely in bounded
+    chunks, including lines straddling a chunk boundary."""
+    import json
+
+    from fedml_tpu.obs.mlops import FedLogs, FileMessenger
+
+    log = tmp_path / "run.log"
+    sink = tmp_path / "logs.jsonl"
+    shipper = FedLogs(log, FileMessenger(sink), run_id=1, edge_id=0)
+    shipper.MAX_BYTES_PER_READ = 64  # force many chunks
+    lines = [f"entry-{i:04d}-padding-to-make-lines-long\n" for i in range(40)]
+    log.write_text("".join(lines))
+    assert shipper.upload_once() == 40
+    recs = [json.loads(l) for l in sink.read_text().splitlines()]
+    got = [ln for r in recs for ln in r["payload"]["logs"]]
+    assert got == lines
