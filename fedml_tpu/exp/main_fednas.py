@@ -26,6 +26,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--channels", type=int, default=4)
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--steps", type=int, default=2)
+    parser.add_argument("--search_mode", type=str, default="darts",
+                        choices=["darts", "gdas"],
+                        help="darts = softmax mixture over ops; gdas = "
+                             "Gumbel-softmax hard sample per forward")
+    parser.add_argument("--tau", type=float, default=5.0,
+                        help="gdas Gumbel temperature")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -68,7 +74,7 @@ def run(args) -> dict:
 
     net = DARTSNetwork(
         num_classes=classes, channels=args.channels, layers=args.layers,
-        steps=args.steps,
+        steps=args.steps, search_mode=args.search_mode, tau=args.tau,
     )
     tr = FedNASTrainer(net, optax.sgd(args.lr), optax.adam(args.arch_lr),
                        epochs=args.epochs)

@@ -42,6 +42,14 @@ def test_main_fednas_smoke():
     assert "genotype_normal" in out
 
 
+def test_main_fednas_gdas_mode():
+    from fedml_tpu.exp.main_fednas import main
+
+    out = main(["--client_number", "2", "--comm_round", "1",
+                "--search_mode", "gdas", "--tau", "2.0"])
+    assert np.isfinite(out["Train/Loss"])
+
+
 def test_main_fedseg_smoke():
     from fedml_tpu.exp.main_fedseg import main
 
