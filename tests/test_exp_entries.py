@@ -204,3 +204,23 @@ def test_yaml_config_coercion_and_choices(tmp_path):
     cf.write_text("epochs: 1.5\n")  # non-integral float for an int flag
     with pytest.raises(ValueError, match="not an integer"):
         parse_with_config(add_args(argparse.ArgumentParser()), ["--cf", str(cf)])
+
+
+@pytest.mark.parametrize("mode,extra", [
+    ("dsgd", []),
+    ("pushsum", ["--time_varying", "1"]),
+    # static pushsum with an irregular graph: exercises the
+    # column-stochastic transpose at the entry
+    ("pushsum", ["--client_number", "7",
+                 "--topology_neighbors_num_undirected", "3"]),
+])
+def test_main_dol_smoke(mode, extra):
+    from fedml_tpu.exp.main_dol import main
+
+    out = main(["--mode", mode, "--data_name", "SUSY",
+                "--client_number", "6", "--iteration_number", "40",
+                "--learning_rate", "0.05", *extra])
+    assert np.isfinite(out["final_regret"])
+    # sublinear regret: the learner makes the late half of the stream
+    # cheaper per round than the early half
+    assert out["late_avg_loss"] < out["early_avg_loss"]
