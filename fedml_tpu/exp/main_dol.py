@@ -14,8 +14,6 @@ from __future__ import annotations
 import argparse
 import logging
 
-import numpy as np
-
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--mode", type=str, default="dsgd",
@@ -24,7 +22,8 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="SUSY | room_occupancy (RO)")
     parser.add_argument("--data_dir", type=str, default=None)
     parser.add_argument("--iteration_number", type=int, default=200,
-                        help="streaming rounds T")
+                        help="streaming rounds T (>= 2: the report splits "
+                             "the stream into halves)")
     parser.add_argument("--client_number", type=int, default=15,
                         help="network size N")
     parser.add_argument("--learning_rate", type=float, default=0.01)
@@ -62,6 +61,8 @@ def run(args) -> dict:
         mode=args.mode, topology=topology,
         time_varying=bool(args.time_varying), seed=args.seed,
     )
+    if len(regret) < 2:
+        raise ValueError("--iteration_number must be >= 2")
     half = len(regret) // 2
     final = {
         "mode": args.mode,
