@@ -19,6 +19,7 @@ import jax.numpy as jnp
 import optax
 
 from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
+from fedml_tpu.core import scan as scanlib
 
 Pytree = Any
 
@@ -123,12 +124,12 @@ def make_gan_local_train(trainer: GANTrainer):
                 opt_states = keep(new_opts, opt_states)
                 return (variables, opt_states, rng), losses["g_loss"] + losses["d_loss"]
 
-            (variables, opt_states, rng), losses = jax.lax.scan(
+            (variables, opt_states, rng), losses = scanlib.scan(
                 step, (variables, opt_states, rng), (jnp.arange(S), data)
             )
             return (variables, opt_states, rng), losses.mean()
 
-        (variables, opt_states, rng), epoch_losses = jax.lax.scan(
+        (variables, opt_states, rng), epoch_losses = scanlib.scan(
             epoch, (global_variables, opt_states, rng), jnp.arange(trainer.epochs)
         )
         return variables, {"train_loss": epoch_losses[-1]}

@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu.core import scan as scanlib
+
 Pytree = Any
 
 
@@ -83,12 +85,12 @@ class FedGKT:
                 updates, opt_state = self.client_opt.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), new_state, opt_state), loss
 
-            (params, state, opt_state), losses = jax.lax.scan(
+            (params, state, opt_state), losses = scanlib.scan(
                 step, (params, state, opt_state), (batches, server_logits)
             )
             return (params, state, opt_state), losses.mean()
 
-        (params, state, opt_state), _ = jax.lax.scan(
+        (params, state, opt_state), _ = scanlib.scan(
             epoch, (cvars["params"], model_state, opt_state), None, length=epochs
         )
         new_cvars = {"params": params, **state}
@@ -133,12 +135,12 @@ class FedGKT:
                 updates, opt_state = self.server_opt.update(grads, opt_state, params)
                 return (optax.apply_updates(params, updates), new_state, opt_state), loss
 
-            (params, state, opt_state), losses = jax.lax.scan(
+            (params, state, opt_state), losses = scanlib.scan(
                 step, (params, state, opt_state), (feats, client_logits, labels, masks)
             )
             return (params, state, opt_state), losses.mean()
 
-        (params, state, opt_state), _ = jax.lax.scan(
+        (params, state, opt_state), _ = scanlib.scan(
             epoch, (svars["params"], model_state, opt_state), None, length=epochs
         )
         new_svars = {"params": params, **state}

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import optax
 
 from fedml_tpu.algorithms.base import Aggregator
+from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core import tree as treelib
 from fedml_tpu.models.darts import DARTSNetwork, decode_genotype
 
@@ -97,12 +98,12 @@ class FedNASTrainer:
                 )
                 return (variables, opt_states, rng_s), losses["train_loss"]
 
-            (variables, opt_states, rng_e), losses = jax.lax.scan(
+            (variables, opt_states, rng_e), losses = scanlib.scan(
                 step, (variables, opt_states, rng_e), (train_batches, val_batches)
             )
             return (variables, opt_states, rng_e), losses.mean()
 
-        (variables, _, _), epoch_losses = jax.lax.scan(
+        (variables, _, _), epoch_losses = scanlib.scan(
             epoch, (global_variables, opt_states, rng), None, length=self.epochs
         )
         return variables, {"train_loss": epoch_losses[-1]}

@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu.core import scan as scanlib
+
 Pytree = Any
 
 
@@ -100,7 +102,7 @@ def run_splitnn_relay(
             cv, sv, c_opt, s_opt, loss = split.train_step(cv, sv, c_opt, s_opt, batch, sub)
             return (cv, sv, c_opt, s_opt, key), loss
 
-        (cv, sv, _, s_opt, _), losses = jax.lax.scan(
+        (cv, sv, _, s_opt, _), losses = scanlib.scan(
             step, (cv, sv, c_opt, s_opt, key), batches
         )
         return cv, sv, s_opt, losses.mean()

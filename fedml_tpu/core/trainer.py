@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from fedml_tpu.core import scan as scanlib
+
 Pytree = Any
 Batch = dict[str, jnp.ndarray]
 
@@ -287,12 +289,12 @@ def make_local_train(trainer: ClientTrainer):
                 w = (jnp.sum(batch["mask"]) > 0).astype(jnp.float32)
                 return (variables, opt_state, rng), (loss, w)
 
-            (variables, opt_state, rng), (losses, ws) = jax.lax.scan(
+            (variables, opt_state, rng), (losses, ws) = scanlib.scan(
                 step_body, (variables, opt_state, rng), (jnp.arange(S), data)
             )
             return (variables, opt_state, rng), (jnp.sum(losses * ws), jnp.sum(ws))
 
-        (variables, opt_state, rng), (loss_sums, w_sums) = jax.lax.scan(
+        (variables, opt_state, rng), (loss_sums, w_sums) = scanlib.scan(
             epoch_body, (global_variables, opt_state, rng), jnp.arange(trainer.epochs)
         )
         # mean loss over executed (unmasked) steps of the last executed epoch
@@ -321,7 +323,7 @@ def make_local_eval(trainer: ClientTrainer):
             m = trainer.eval_batch(variables, batch)
             return carry, m
 
-        _, metrics = jax.lax.scan(step, 0, data)
+        _, metrics = scanlib.scan(step, 0, data)
         return jax.tree.map(lambda x: jnp.sum(x, axis=0), metrics)
 
     return local_eval

@@ -200,7 +200,9 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             def step(c, b):
                 return c, trainer.eval_batch(variables, b)
 
-            _, m = jax.lax.scan(step, 0, test_batches)
+            from fedml_tpu.core import scan as scanlib
+
+            _, m = scanlib.scan(step, 0, test_batches)
             s = jax.tree.map(lambda x: jnp.sum(x, 0), m)
             tot = jnp.maximum(s["test_total"], 1.0)
             return s["test_correct"] / tot, s["test_loss"] / tot

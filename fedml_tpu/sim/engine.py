@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
 from fedml_tpu.core import rng as rnglib
+from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
 from fedml_tpu.parallel import mesh as meshlib
 from fedml_tpu.sim import cohort as cohortlib
@@ -59,6 +60,12 @@ class SimConfig:
     # (on when the dataset fits comfortably in HBM). The host-staging path
     # remains for datasets larger than device memory.
     stage_on_device: bool | None = None
+    # Dispatch rounds in eval-aligned blocks (one lax.scan program per block,
+    # one host->device round-trip). None = auto: on for accelerator meshes
+    # (where dispatch latency dominates small models), OFF on XLA:CPU —
+    # convolutions inside a while loop take XLA:CPU's single-threaded slow
+    # path, ~100x slower than the same round dispatched directly.
+    block_dispatch: bool | None = None
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
@@ -170,6 +177,12 @@ class FedSim:
             if config.stage_on_device is not None
             else nbytes <= 2 << 30
         )
+        self._block_dispatch = (
+            config.block_dispatch
+            if config.block_dispatch is not None
+            else (self._on_device
+                  and next(iter(self.mesh.devices.flat)).platform != "cpu")
+        ) and self._on_device
         if self._on_device:
             self._dataset = self._put(
                 {k: np.asarray(v) for k, v in train_data.arrays.items()},
@@ -411,7 +424,7 @@ class FedSim:
         def step(carry, batch):
             return carry, self.trainer.eval_batch(variables, batch)
 
-        _, m = jax.lax.scan(step, 0, batches)
+        _, m = scanlib.scan(step, 0, batches)
         summed = jax.tree.map(lambda x: jnp.sum(x, axis=0), m)
         total = jnp.maximum(summed["test_total"], 1.0)
         return {
@@ -690,7 +703,8 @@ class FedSim:
         # Dispatch rounds in blocks aligned to eval boundaries (one device
         # dispatch per block amortizes host->device latency; alignment keeps
         # every eval at a block end so accuracy is attributed to the right
-        # round); single-round blocks when the dataset is host-staged.
+        # round); single-round dispatch when blocks are off (host-staged
+        # dataset, or XLA:CPU — see SimConfig.block_dispatch).
         freq = max(cfg.frequency_of_the_test, 1)
         try:
             r = start_round
@@ -704,7 +718,8 @@ class FedSim:
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
                 next_eval = ((r // freq) + 1) * freq
-                n = min(cfg.comm_round, next_eval) - r if self._on_device else 1
+                n = (min(cfg.comm_round, next_eval) - r
+                     if self._block_dispatch else 1)
                 # the first round runs alone so the profile skips compilation
                 if cfg.profile_dir and r == start_round:
                     n = 1

@@ -1,0 +1,79 @@
+"""The CPU straight-lining scan helper (fedml_tpu/core/scan.py) must be a
+drop-in for lax.scan: same carries/ys, zero-length handling, and a TOTAL
+straight-line budget across nested scans."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import scan as scanlib
+
+
+def _body(c, x):
+    return c + x, c * 2.0
+
+
+def test_matches_lax_scan():
+    xs = jnp.arange(10.0)
+    c1, ys1 = scanlib.scan(_body, 0.0, xs)
+    c2, ys2 = jax.lax.scan(_body, 0.0, xs)
+    np.testing.assert_allclose(c1, c2)
+    np.testing.assert_allclose(ys1, ys2)
+
+
+def test_zero_length_matches_lax_scan():
+    xs = jnp.zeros((0, 3))
+    c1, ys1 = scanlib.scan(lambda c, x: (c + x.sum(), x), 0.0, xs)
+    c2, ys2 = jax.lax.scan(lambda c, x: (c + x.sum(), x), 0.0, xs)
+    assert ys1.shape == ys2.shape == (0, 3)
+    np.testing.assert_allclose(c1, c2)
+
+
+def test_long_scan_stays_rolled_and_correct():
+    xs = jnp.arange(float(scanlib.UNROLL_CAP + 10))
+    c1, ys1 = scanlib.scan(_body, 0.0, xs)
+    c2, ys2 = jax.lax.scan(_body, 0.0, xs)
+    np.testing.assert_allclose(c1, c2)
+    np.testing.assert_allclose(ys1, ys2)
+
+
+def test_nested_budget_is_shared():
+    """An outer straight-lined scan shrinks the inner budget so E x S can
+    never emit more than ~UNROLL_CAP straight-lined bodies."""
+    calls = {"straight": 0}
+    orig_lax_scan = jax.lax.scan
+
+    E, S = 8, 16  # 8*16=128 > 64: inner scans must fall back to lax.scan
+
+    def inner_body(c, x):
+        return c + x, x
+
+    def outer_body(c, e):
+        c2, _ = scanlib.scan(inner_body, c, jnp.arange(float(S)))
+        return c2, e
+
+    import unittest.mock as mock
+
+    with mock.patch.object(jax.lax, "scan", side_effect=orig_lax_scan) as m:
+        c, _ = scanlib.scan(outer_body, 0.0, jnp.arange(float(E)))
+        # the outer scan straight-lined (8 <= 64) but every inner scan
+        # (budget 64 // 8 = 8 < 16) delegated to lax.scan
+        assert m.call_count == E
+    np.testing.assert_allclose(c, E * (S * (S - 1) / 2))
+
+
+def test_nested_within_budget_straight_lines_everything():
+    E, S = 4, 8  # 4*8 = 32 <= 64: no lax.scan at all on CPU
+    import unittest.mock as mock
+
+    def inner_body(c, x):
+        return c + x, x
+
+    def outer_body(c, e):
+        c2, _ = scanlib.scan(inner_body, c, jnp.arange(float(S)))
+        return c2, e
+
+    with mock.patch.object(jax.lax, "scan") as m:
+        c, _ = scanlib.scan(outer_body, 0.0, jnp.arange(float(E)))
+        assert m.call_count == 0
+    np.testing.assert_allclose(c, E * (S * (S - 1) / 2))
