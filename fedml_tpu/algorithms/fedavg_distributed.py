@@ -325,15 +325,21 @@ class FedAvgClientManager(ClientManager):
 
 
 def init_template(trainer: ClientTrainer, train_arrays: dict, batch_size: int,
-                  seed: int = 0):
+                  seed: int = 0, init_overrides=None):
     """Shared harness setup: init the model from a data sample and pack it
-    for the wire. Returns (template pytree, flat bytes, descriptor)."""
+    for the wire. Returns (template pytree, flat bytes, descriptor).
+    ``init_overrides`` grafts warm-start collections (a ``load_params`` dict)
+    over the fresh init — the message-passing side of ``--init_from``."""
     sample = {
         name: jnp.asarray(arr[:batch_size]) for name, arr in train_arrays.items()
     }
     sample.setdefault("mask", jnp.ones((batch_size,), jnp.float32))
     template = trainer.init(jax.random.key(seed), sample)
     template = jax.tree.map(np.asarray, template)
+    if init_overrides:
+        from fedml_tpu.obs.checkpoint import graft_params
+
+        template = graft_params(dict(template), dict(init_overrides))
     flat, desc = pack_pytree(template)
     return template, flat, desc
 
@@ -362,6 +368,7 @@ def run_distributed_fedavg(
     seed: int = 0,
     round_timeout: float | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
+    init_overrides=None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -369,7 +376,8 @@ def run_distributed_fedavg(
     threads — the single-host harness the reference lacked (SURVEY §4); the
     same managers drive separate processes when the transport spans them.
     Returns the final global variables."""
-    template, flat, desc = init_template(trainer, train_data.arrays, batch_size, seed)
+    template, flat, desc = init_template(trainer, train_data.arrays, batch_size,
+                                         seed, init_overrides=init_overrides)
 
     results: dict[str, np.ndarray] = {}
 
@@ -404,6 +412,7 @@ def run_distributed_fedavg_loopback(
     batch_size: int,
     seed: int = 0,
     on_round_done: Callable[[int, Any], None] | None = None,
+    init_overrides=None,
 ):
     """Distributed FedAvg on the in-process loopback fabric."""
     from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
@@ -412,7 +421,7 @@ def run_distributed_fedavg_loopback(
     return run_distributed_fedavg(
         trainer, train_data, worker_num, round_num, batch_size,
         lambda r: LoopbackCommManager(fabric, r), seed=seed,
-        on_round_done=on_round_done,
+        on_round_done=on_round_done, init_overrides=init_overrides,
     )
 
 
@@ -425,6 +434,7 @@ def run_distributed_fedavg_shm(
     seed: int = 0,
     job: str | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
+    init_overrides=None,
 ):
     """Distributed FedAvg over the native shared-memory rings (the MPI-role
     single-host transport, comm/shm.py + ops/native/shm_ring.cpp)."""
@@ -440,6 +450,7 @@ def run_distributed_fedavg_shm(
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
             lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
+            init_overrides=init_overrides,
         )
     finally:
         for m in mgrs.values():
@@ -455,6 +466,7 @@ def run_distributed_fedavg_grpc(
     seed: int = 0,
     base_port: int = 29500,
     on_round_done: Callable[[int, Any], None] | None = None,
+    init_overrides=None,
 ):
     """Distributed FedAvg over localhost gRPC (cross-host transport run
     single-host; an ip_config table generalizes it to a cluster, reference
@@ -469,7 +481,74 @@ def run_distributed_fedavg_grpc(
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
             lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
+            init_overrides=init_overrides,
         )
     finally:
         for m in mgrs.values():
             m.stop_receive_message()
+
+
+def run_distributed_fedavg_mqtt_s3(
+    trainer: ClientTrainer,
+    train_data: FederatedArrays,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+    store_dir: str | None = None,
+    mqtt_host: str | None = None,
+    mqtt_port: int = 1883,
+    topic: str = "fedml",
+    threshold_bytes: int = 1 << 14,
+    on_round_done: Callable[[int, Any], None] | None = None,
+    init_overrides=None,
+):
+    """Distributed FedAvg over the production WAN combination: control
+    messages on MQTT topics, model payloads through an object store keyed by
+    reference (the reference's MQTT_S3 backend,
+    mqtt_s3_multi_clients_comm_manager.py:178-249 / client_manager.py:28-50).
+
+    ``mqtt_host=None`` (offline default) runs the real MqttCommManager logic
+    over the in-process broker (comm/inproc_broker.py); a host string
+    connects through real paho. The store is a FileSystemStore under
+    ``store_dir`` — the S3Store drops in via the same ObjectStore interface.
+    """
+    import tempfile
+
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+    from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+    factory = None
+    if mqtt_host is None:
+        from fedml_tpu.comm.inproc_broker import InProcessBroker
+
+        factory = InProcessBroker().client_factory()
+        mqtt_host = "inproc"
+    tmp_store = None
+    if store_dir is None:
+        tmp_store = tempfile.mkdtemp(prefix="fedml_store_")
+    store_root = store_dir or tmp_store
+
+    def make_comm(rank: int):
+        inner = MqttCommManager(
+            mqtt_host, mqtt_port, topic=topic, client_id=rank,
+            client_num=worker_num, client_factory=factory,
+        )
+        return OffloadCommManager(
+            inner, FileSystemStore(store_root), threshold_bytes=threshold_bytes
+        )
+
+    mgrs = {r: make_comm(r) for r in range(worker_num + 1)}
+    try:
+        return run_distributed_fedavg(
+            trainer, train_data, worker_num, round_num, batch_size,
+            lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
+            init_overrides=init_overrides,
+        )
+    finally:
+        for m in mgrs.values():
+            m.stop_receive_message()
+        if tmp_store is not None:
+            import shutil
+
+            shutil.rmtree(tmp_store, ignore_errors=True)

@@ -8,8 +8,19 @@ record_model_global_architecture:173 decodes the genotype each round.
 
 Here the bilevel alternation is a jitted scan over (train, val) batch pairs:
 the α step takes the gradient of the *validation* loss w.r.t. the ``arch``
-collection (first-order DARTS; the reference's default unrolled=False path),
-the weight step the training loss w.r.t. ``params``.
+collection, the weight step the training loss w.r.t. ``params``.
+
+Both architect orders are offered (architect.py:47-55 ``unrolled`` flag):
+- first-order (reference ``_backward_step``): ∇α L_val(w, α);
+- second-order (``unrolled=True``, reference ``_backward_step_unrolled``
+  :169-197, DARTS eq. 7): w' = one real optimizer step on L_train, then
+  ∇α L_val(w', α) − η · ∇²_{α,w} L_train(w, α) · ∇w' L_val(w', α).
+  The reference approximates the Hessian-vector product by a finite
+  difference around w (``_hessian_vector_product``:229-259, eq. 8); here it
+  is EXACT — one ``jax.jvp`` through ``jax.grad`` — which is both cheaper
+  (no ±R parameter reconstruction) and what the finite difference converges
+  to. ``tests/test_fednas.py`` checks it against that finite-difference
+  oracle.
 """
 
 from __future__ import annotations
@@ -35,6 +46,11 @@ class FedNASTrainer:
     w_opt: optax.GradientTransformation
     arch_opt: optax.GradientTransformation
     epochs: int = 1
+    # second-order architect (architect.py:47): unroll one weight step before
+    # the α gradient; ``unrolled_eta`` is the reference's η (network lr) that
+    # scales the implicit term in DARTS eq. 7
+    unrolled: bool = False
+    unrolled_eta: float = 0.025
 
     def init(self, rng: jax.Array, sample_x: jnp.ndarray) -> Pytree:
         return dict(self.network.init({"params": rng}, sample_x, train=False))
@@ -49,6 +65,39 @@ class FedNASTrainer:
         m = batch["mask"]
         return jnp.sum(ce * m) / jnp.maximum(jnp.sum(m), 1.0), new_state
 
+    def arch_grads_unrolled(self, params, arch, state, w_opt_state,
+                            train_batch, val_batch, t_rng, v_rng):
+        """Second-order architecture gradient (architect.py:169-197).
+
+        w' is one REAL ``w_opt`` update on the training loss (the reference
+        reconstructs momentum-SGD by hand in ``_compute_unrolled_model``:32;
+        using the live optimizer state covers the same momentum semantics for
+        any optax chain), and the ∇²_{α,w}·v term is the exact jvp the
+        reference's ±R finite difference (eq. 8) approximates.
+        """
+        def loss_t(p, a):
+            return self._loss(p, a, state, train_batch, t_rng)[0]
+
+        def loss_v(p, a):
+            return self._loss(p, a, state, val_batch, v_rng)[0]
+
+        g_w = jax.grad(loss_t)(params, arch)
+        updates, _ = self.w_opt.update(g_w, w_opt_state, params)
+        w_unrolled = optax.apply_updates(params, updates)
+
+        val_loss, (dalpha, vector) = jax.value_and_grad(
+            lambda a, p: loss_v(p, a), argnums=(0, 1)
+        )(arch, w_unrolled)
+        # exact ∇²_{α,w} L_train(w, α) · vector: differentiate ∇α L_train
+        # along direction `vector` in w
+        _, implicit = jax.jvp(
+            lambda p: jax.grad(loss_t, argnums=1)(p, arch), (params,), (vector,)
+        )
+        a_grads = jax.tree.map(
+            lambda d, i: d - self.unrolled_eta * i, dalpha, implicit
+        )
+        return val_loss, a_grads
+
     def search_step(self, variables: Pytree, opt_states, train_batch, val_batch,
                     rng=None):
         """One bilevel alternation (FedNASTrainer.local_search:82-127)."""
@@ -58,10 +107,17 @@ class FedNASTrainer:
         state = {k: v for k, v in variables.items() if k not in ("params", "arch")}
         w_opt_state, a_opt_state = opt_states
 
-        # α step on validation loss (architect.step, first-order)
-        (val_loss, _), a_grads = jax.value_and_grad(
-            lambda a: self._loss(params, a, state, val_batch, a_rng), has_aux=True
-        )(arch)
+        if self.unrolled:
+            # α step through the unrolled weight step (architect.step unrolled)
+            val_loss, a_grads = self.arch_grads_unrolled(
+                params, arch, state, w_opt_state, train_batch, val_batch,
+                w_rng, a_rng,
+            )
+        else:
+            # α step on validation loss (architect.step, first-order)
+            (val_loss, _), a_grads = jax.value_and_grad(
+                lambda a: self._loss(params, a, state, val_batch, a_rng), has_aux=True
+            )(arch)
         a_updates, a_opt_state = self.arch_opt.update(a_grads, a_opt_state, arch)
         arch = optax.apply_updates(arch, a_updates)
 

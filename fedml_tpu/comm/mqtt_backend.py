@@ -30,16 +30,13 @@ from fedml_tpu.comm.message import Message
 class MqttCommManager(BaseCommunicationManager):
     def __init__(self, host: str, port: int, topic: str = "fedml",
                  client_id: int = 0, client_num: int = 0,
-                 status_topic: str | None = None, keepalive: int = 180):
-        try:
-            import paho.mqtt.client as mqtt
-        except ImportError as e:
-            raise ImportError(
-                "MqttCommManager requires paho-mqtt (not in this image); "
-                "use the loopback/shm/grpc backends instead"
-            ) from e
+                 status_topic: str | None = None, keepalive: int = 180,
+                 client_factory=None):
+        """``client_factory`` substitutes the broker client construction
+        (paho by default) — e.g. ``InProcessBroker().client_factory()`` for
+        the offline ``mqtt_s3`` CLI backend. Everything above it (topic
+        scheme, wire format, wills, status) is unchanged."""
         super().__init__()
-        self._mqtt = mqtt
         self.topic = topic
         self.client_id = client_id
         self.client_num = client_num
@@ -47,26 +44,51 @@ class MqttCommManager(BaseCommunicationManager):
         self._stop = threading.Event()
         self._q: queue.Queue = queue.Queue()
 
-        if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
-            self.client = mqtt.Client(
-                mqtt.CallbackAPIVersion.VERSION1,
-                client_id=f"{topic}-{client_id}",
-                protocol=mqtt.MQTTv311,
+        if client_factory is not None:
+            self.client = client_factory(
+                client_id=f"{topic}-{client_id}", protocol=None
             )
         else:
-            self.client = mqtt.Client(
-                client_id=f"{topic}-{client_id}", protocol=mqtt.MQTTv311
-            )
+            try:
+                import paho.mqtt.client as mqtt
+            except ImportError as e:
+                raise ImportError(
+                    "MqttCommManager requires paho-mqtt (not in this image); "
+                    "use the loopback/shm/grpc backends, or pass an "
+                    "in-process client_factory (comm/inproc_broker.py)"
+                ) from e
+            if hasattr(mqtt, "CallbackAPIVersion"):  # paho-mqtt >= 2.0
+                self.client = mqtt.Client(
+                    mqtt.CallbackAPIVersion.VERSION1,
+                    client_id=f"{topic}-{client_id}",
+                    protocol=mqtt.MQTTv311,
+                )
+            else:
+                self.client = mqtt.Client(
+                    client_id=f"{topic}-{client_id}", protocol=mqtt.MQTTv311
+                )
         # last-will: broker announces our death on the status topic
         self.client.will_set(
             self.status_topic,
             json.dumps({"id": client_id, "status": "OFFLINE"}),
             qos=1, retain=False,
         )
+        self._subscribed = threading.Event()
         self.client.on_connect = self._on_connect
         self.client.on_message = self._on_message
         self.client.connect(host, port, keepalive)
         self.client.loop_start()
+        # Block until our subscriptions are registered: with a real broker,
+        # CONNACK-driven _on_connect runs on paho's network thread, and a
+        # QoS1 non-retained publish to a topic with no subscriber yet is
+        # silently dropped — the protocol's init broadcast would vanish and
+        # the run would hang. Construction-order guarantee: every manager's
+        # constructor returns only after its own subscribe, so init messages
+        # sent after all managers exist always have their subscribers.
+        if not self._subscribed.wait(timeout=30.0):
+            raise TimeoutError(
+                f"mqtt: no CONNACK/subscribe within 30 s (broker {host}:{port})"
+            )
 
     # topic scheme (mqtt_comm_manager.py:47-70)
     def _send_topic(self, receiver_id: int) -> str:
@@ -91,6 +113,7 @@ class MqttCommManager(BaseCommunicationManager):
             json.dumps({"id": self.client_id, "status": "ONLINE"}),
             qos=1,
         )
+        self._subscribed.set()
 
     def _on_message(self, client, userdata, mqtt_msg):
         try:

@@ -115,20 +115,98 @@ def fixed_partition(distribution: dict[int, np.ndarray]) -> dict[int, np.ndarray
     return {int(k): np.asarray(v, dtype=np.int64) for k, v in distribution.items()}
 
 
+def read_net_dataidx_map(path) -> dict[int, np.ndarray]:
+    """Read a saved client→sample-index map for ``hetero-fix``.
+
+    Accepts both formats a reference user may have on disk:
+    - the reference's printed-dict ``net_dataidx_map.txt``
+      (cifar10/data_loader.py:31-43 ``read_net_dataidx_map``): ``N: [`` opens
+      client N, subsequent comma-separated integer lines are its indices,
+      ``]``/``{``/``}`` lines are structure;
+    - plain JSON ``{"client": [indices...]}``.
+    """
+    import json
+    from pathlib import Path
+
+    text = Path(path).read_text()
+    try:
+        return fixed_partition(json.loads(text))
+    except json.JSONDecodeError:
+        pass  # not JSON — the reference's printed-dict layout
+    mapping: dict[int, list[int]] = {}
+    key = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line[0] in "{}]":
+            continue
+        head, _, tail = line.partition(":")
+        if tail.strip() == "[":
+            key = int(head)
+            mapping[key] = []
+        else:
+            if key is None:
+                raise ValueError(f"malformed dataidx map line: {line!r}")
+            mapping[key].extend(
+                int(tok) for tok in line.replace("]", "").split(",") if tok.strip()
+            )
+    if not mapping:
+        raise ValueError(f"no client index lists found in {path}")
+    return fixed_partition(mapping)
+
+
+def write_net_dataidx_map(path, net_dataidx_map: dict[int, np.ndarray]) -> None:
+    """Write a partition in the reference's ``net_dataidx_map.txt`` layout so
+    the file round-trips through both this reader and the reference's."""
+    from pathlib import Path
+
+    lines = ["{"]
+    for client in sorted(net_dataidx_map):
+        lines.append(f"{int(client)}: [")
+        lines.append(", ".join(str(int(i)) for i in net_dataidx_map[client]))
+        lines.append("]")
+    lines.append("}")
+    Path(path).write_text("\n".join(lines) + "\n")
+
+
 def partition(
     method: str,
     labels: np.ndarray,
     n_clients: int,
     alpha: float = 0.5,
     seed: int = 0,
+    dataidx_map_path=None,
 ) -> dict[int, np.ndarray]:
-    """Dispatch by reference partition_method name."""
+    """Dispatch by reference partition_method name. ``hetero-fix`` loads the
+    saved distribution at ``dataidx_map_path`` (reference hard-codes
+    ``./data_preprocessing/non-iid-distribution/<DS>/net_dataidx_map.txt``;
+    here the path is explicit)."""
     if method == "homo":
         return homo_partition(len(labels), n_clients, seed)
     if method in ("hetero", "dirichlet", "noniid"):
         return dirichlet_partition(labels, n_clients, alpha, seed=seed)
     if method in ("power-law", "power_law"):
         return powerlaw_partition(labels, n_clients, seed=seed)
+    if method == "hetero-fix":
+        if dataidx_map_path is None:
+            raise ValueError(
+                "partition_method='hetero-fix' needs dataidx_map_path "
+                "(--dataidx_map_path, a saved net_dataidx_map.txt)"
+            )
+        mapping = read_net_dataidx_map(dataidx_map_path)
+        if set(mapping) != set(range(n_clients)):
+            raise ValueError(
+                f"hetero-fix map at {dataidx_map_path} has clients "
+                f"{sorted(mapping)} but client_num_in_total={n_clients} "
+                f"needs exactly 0..{n_clients - 1}"
+            )
+        n = len(labels)
+        for client, idxs in mapping.items():
+            if len(idxs) and (idxs.min() < 0 or idxs.max() >= n):
+                raise ValueError(
+                    f"hetero-fix map at {dataidx_map_path}: client {client} "
+                    f"indexes outside the {n}-sample dataset"
+                )
+        return mapping
     raise ValueError(f"unknown partition method: {method!r}")
 
 

@@ -32,7 +32,16 @@ def scan(body, init, xs, length=None):
         length = jax.tree.leaves(xs)[0].shape[0]
     if (length == 0 or length > _budget[-1]
             or jax.default_backend() != "cpu"):
-        return jax.lax.scan(body, init, xs, length=length)
+        if jax.default_backend() != "cpu" or length == 0:
+            return jax.lax.scan(body, init, xs, length=length)
+        # Rolled on CPU: nested scans inside this while body must stay rolled
+        # too (straight-lining them would bloat the HLO ~length-fold while the
+        # outer loop keeps convs on the slow conv-in-while path anyway).
+        _budget.append(0)
+        try:
+            return jax.lax.scan(body, init, xs, length=length)
+        finally:
+            _budget.pop()
     carry = init
     ys = []
     _budget.append(max(_budget[-1] // length, 0))

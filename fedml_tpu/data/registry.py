@@ -91,6 +91,7 @@ def load_partition_data(
     seed: int = 0,
     image_size: int | None = None,
     limit_per_class: int | None = None,
+    dataidx_map_path: str | None = None,
 ) -> FedDataset:
     """Dataset-name dispatch matching the reference experiment scripts'
     ``load_data`` (main_fedavg.py:133-351). Falls back to hermetic synthetic
@@ -103,7 +104,8 @@ def load_partition_data(
         from fedml_tpu.data.cv import load_cifar
 
         train, test, class_num = load_cifar(
-            dataset, data_dir, partition_method, partition_alpha, client_num_in_total, seed
+            dataset, data_dir, partition_method, partition_alpha, client_num_in_total,
+            seed, dataidx_map_path=dataidx_map_path, limit_per_class=limit_per_class,
         )
         return FedDataset(train, test, class_num, name=dataset)
 

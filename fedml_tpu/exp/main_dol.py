@@ -41,6 +41,10 @@ def run(args) -> dict:
     from fedml_tpu.topology.topology import SymmetricTopologyManager
 
     logging_config(0)
+    if args.iteration_number < 2:
+        # fail before the gossip run, not after it: the report splits the
+        # stream into halves and needs at least two rounds
+        raise ValueError("--iteration_number must be >= 2")
     name = {"ro": "room_occupancy"}.get(args.data_name.lower(), args.data_name)
     xs, ys = load_streaming(
         name, args.data_dir, n_nodes=args.client_number,
@@ -61,8 +65,6 @@ def run(args) -> dict:
         mode=args.mode, topology=topology,
         time_varying=bool(args.time_varying), seed=args.seed,
     )
-    if len(regret) < 2:
-        raise ValueError("--iteration_number must be >= 2")
     half = len(regret) // 2
     final = {
         "mode": args.mode,

@@ -33,6 +33,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--data_dir", type=str, default=None)
     parser.add_argument("--partition_method", type=str, default="hetero")
     parser.add_argument("--partition_alpha", type=float, default=0.5)
+    parser.add_argument("--dataidx_map_path", type=str, default=None,
+                        help="saved net_dataidx_map file for "
+                             "--partition_method hetero-fix (reference "
+                             "cifar10/data_loader.py:150-158; txt or JSON)")
     parser.add_argument("--client_num_in_total", type=int, default=10)
     parser.add_argument("--client_num_per_round", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=32)
@@ -47,10 +51,23 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--ci", type=int, default=0)
     parser.add_argument("--is_mobile", type=int, default=0)  # parity no-op: payloads are arrays
     parser.add_argument("--backend", type=str, default="sim",
-                        choices=["sim", "loopback", "shm", "grpc"],
+                        choices=["sim", "loopback", "shm", "grpc", "mqtt_s3"],
                         help="sim = vectorized single-program engine; "
-                             "loopback/shm/grpc = real message-passing FedAvg "
-                             "protocol over the chosen transport")
+                             "loopback/shm/grpc/mqtt_s3 = real message-passing "
+                             "FedAvg protocol over the chosen transport "
+                             "(mqtt_s3: control plane on MQTT topics, model "
+                             "blobs through the object store; offline it runs "
+                             "on the in-process broker + filesystem store)")
+    parser.add_argument("--mqtt_host", type=str, default=None,
+                        help="real MQTT broker host for --backend mqtt_s3 "
+                             "(default: in-process broker)")
+    parser.add_argument("--mqtt_port", type=int, default=1883)
+    parser.add_argument("--object_store_dir", type=str, default=None,
+                        help="filesystem object-store root for mqtt_s3 "
+                             "(default: a temp dir)")
+    parser.add_argument("--offload_threshold_bytes", type=int, default=1 << 14,
+                        help="arrays >= this many bytes ride the object "
+                             "store instead of the MQTT control plane")
     # algorithm switch (fedall) + algorithm-specific knobs
     parser.add_argument("--algorithm", type=str, default="fedavg",
                         choices=["fedavg", "fedopt", "fedprox", "fednova", "fedgan",
@@ -93,6 +110,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--checkpoint_dir", type=str, default=None)
     parser.add_argument("--checkpoint_every", type=int, default=0)
     parser.add_argument("--resume", type=int, default=0)
+    parser.add_argument("--init_from", type=str, default=None,
+                        help="warm-start params from a save_params .npz "
+                             "(reference pretrained checkpoints, "
+                             "resnet.py:202-224)")
+    parser.add_argument("--save_params_to", type=str, default=None,
+                        help="write the final global model variables as a "
+                             "save_params .npz (reusable via --init_from)")
     return parser
 
 
@@ -182,9 +206,12 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     import jax
     import jax.numpy as jnp
 
+    import functools
+
     from fedml_tpu.algorithms.fedavg_distributed import (
         run_distributed_fedavg_grpc,
         run_distributed_fedavg_loopback,
+        run_distributed_fedavg_mqtt_s3,
         run_distributed_fedavg_shm,
     )
     from fedml_tpu.sim import cohort as cohortlib
@@ -223,15 +250,34 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         "loopback": run_distributed_fedavg_loopback,
         "shm": run_distributed_fedavg_shm,
         "grpc": run_distributed_fedavg_grpc,
+        "mqtt_s3": functools.partial(
+            run_distributed_fedavg_mqtt_s3,
+            store_dir=args.object_store_dir,
+            mqtt_host=args.mqtt_host,
+            mqtt_port=args.mqtt_port,
+            threshold_bytes=args.offload_threshold_bytes,
+        ),
     }
-    runners[args.backend](
+    overrides = None
+    if getattr(args, "init_from", None):
+        from fedml_tpu.obs.checkpoint import load_params
+
+        overrides = load_params(args.init_from)
+        logging.info("warm-starting from %s", args.init_from)
+    final_variables = runners[args.backend](
         trainer, ds.train,
         worker_num=cfg.client_num_per_round,
         round_num=cfg.comm_round,
         batch_size=cfg.batch_size,
         seed=cfg.seed,
         on_round_done=on_round,
+        init_overrides=overrides,
     )
+    if getattr(args, "save_params_to", None):
+        from fedml_tpu.obs.checkpoint import save_params
+
+        saved = save_params(args.save_params_to, final_variables)
+        logging.info("saved final model variables to %s", saved)
     return history
 
 
@@ -249,6 +295,7 @@ def run(args) -> list[dict]:
     ds = load_partition_data(
         args.dataset, args.data_dir, args.partition_method, args.partition_alpha,
         args.client_num_in_total, args.seed,
+        dataidx_map_path=getattr(args, "dataidx_map_path", None),
     )
     model = create_model(args.model, ds.class_num, args.dataset,
                          dtype=getattr(args, "model_dtype", None))
@@ -334,11 +381,19 @@ def run(args) -> list[dict]:
 
         ckptr = RoundCheckpointer(args.checkpoint_dir)
 
+    overrides = None
+    if args.init_from:
+        from fedml_tpu.obs.checkpoint import load_params
+
+        overrides = load_params(args.init_from)
+        logging.info("warm-starting from %s (collections: %s)",
+                     args.init_from, sorted(overrides))
+
     # checkpoint/resume-aware run. Without checkpointing, the engine's
     # run() drives everything (block dispatch, profiling, per-client eval).
     # With checkpointing, rounds run one dispatch at a time so every saved
     # round has its exact model state.
-    variables = sim.init_round_variables()
+    variables = sim.init_round_variables(overrides)
     server_state = sim.aggregator.init_state(variables)
     start_round = 0
     history: list[dict] = []
@@ -349,12 +404,20 @@ def run(args) -> list[dict]:
         start_round += 1
         logging.info("resumed from round %d", start_round - 1)
 
+    def _maybe_save_params(final_variables):
+        if args.save_params_to:
+            from fedml_tpu.obs.checkpoint import save_params
+
+            saved = save_params(args.save_params_to, sim.consensus(final_variables))
+            logging.info("saved final model variables to %s", saved)
+
     if ckptr is None or not args.checkpoint_every:
-        _, run_history = sim.run(
+        final_variables, run_history = sim.run(
             callback=lambda rec: metrics.log(rec, round_idx=rec["round"]),
             variables=variables, server_state=server_state,
             start_round=start_round,
         )
+        _maybe_save_params(final_variables)
         metrics.close()
         return history + run_history
 
@@ -377,6 +440,7 @@ def run(args) -> list[dict]:
         metrics.log(rec, round_idx=r)
         if (r + 1) % args.checkpoint_every == 0:
             ckptr.save(r, variables, server_state, history)
+    _maybe_save_params(variables)
     metrics.close()
     return history
 

@@ -32,6 +32,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "Gumbel-softmax hard sample per forward")
     parser.add_argument("--tau", type=float, default=5.0,
                         help="gdas Gumbel temperature")
+    parser.add_argument("--unrolled", type=int, default=0,
+                        help="1 = second-order architect (reference "
+                             "architect.py:47 unrolled=True): one unrolled "
+                             "weight step + exact Hessian-vector term")
     parser.add_argument("--seed", type=int, default=0)
     return parser
 
@@ -77,7 +81,8 @@ def run(args) -> dict:
         steps=args.steps, search_mode=args.search_mode, tau=args.tau,
     )
     tr = FedNASTrainer(net, optax.sgd(args.lr), optax.adam(args.arch_lr),
-                       epochs=args.epochs)
+                       epochs=args.epochs,
+                       unrolled=bool(args.unrolled), unrolled_eta=args.lr)
     agg = fednas_aggregator()
 
     # per-client train/val batch stacks (bilevel search needs both)

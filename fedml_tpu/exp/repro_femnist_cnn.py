@@ -39,8 +39,10 @@ def run(args) -> dict:
         (data_dir / "fed_emnist_train.h5").exists()
         and not (data_dir / FIXTURE_MARKER).exists()
     )
-    if not real and not (data_dir / "fed_emnist_train.h5").exists():
-        logging.info("no fed_emnist h5 at %s — generating offline fixture", data_dir)
+    if not real:
+        # idempotent: regenerates only when absent or when the marker records
+        # a different (n_clients, seed) than this run requests
+        logging.info("no real fed_emnist h5 at %s — using offline fixture", data_dir)
         write_femnist_h5_fixture(data_dir, n_clients=args.client_num_in_total,
                                  seed=args.seed)
     ds = load_partition_data("femnist", str(data_dir),

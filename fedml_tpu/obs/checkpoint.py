@@ -21,6 +21,88 @@ def _np_tree(tree):
     return jax.tree.map(np.asarray, tree)
 
 
+def save_params(path: str | Path, variables: Any) -> Path:
+    """Save model variables (or any array pytree of nested dicts) as a single
+    portable ``.npz`` keyed by '/'-joined key paths.
+
+    The warm-start half of the reference's pretrained-checkpoint story
+    (fedml_api/model/cv/resnet.py:202-224 loads
+    ``cv/pretrained/*/resnet56/checkpoint.pth``): any zoo model's params can
+    be saved once and loaded into a fresh run via ``--init_from``.
+    """
+    path = Path(path)
+    if path.suffix != ".npz":
+        # np.savez would silently append .npz, making the returned (and
+        # --init_from'd) path not exist; normalize up front instead
+        path = path.with_name(path.name + ".npz")
+    path.parent.mkdir(parents=True, exist_ok=True)
+    flat = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node)
+
+    walk("", dict(variables))
+    if not flat:
+        raise ValueError("save_params: empty variables pytree")
+    np.savez(path, **flat)
+    return path
+
+
+def load_params(path: str | Path, like: Any = None) -> Any:
+    """Load a ``save_params`` file back into a nested dict.
+
+    With ``like`` (a template pytree), every loaded leaf must exist in the
+    template with the same shape — loudly catching a checkpoint/model
+    mismatch — and the result keeps exactly the template's structure with
+    loaded leaves grafted in (missing leaves keep the template's values, so a
+    backbone-only file warm-starts a model with a fresh head).
+    """
+    blob = np.load(Path(path))
+    out: dict = {}
+    for key in blob.files:
+        node = out
+        parts = key.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = blob[key]
+    if like is None:
+        return out
+    return graft_params(dict(like), out)
+
+
+def graft_params(template: Any, loaded: Any, prefix: str = "") -> Any:
+    """Graft ``loaded`` leaves over ``template`` (shape-checked; loaded dict
+    keys must exist in the template; template leaves absent from ``loaded``
+    keep their — e.g. freshly initialized — values)."""
+    if not isinstance(loaded, dict):
+        tmpl_arr = np.asarray(template)
+        loaded = np.asarray(loaded)
+        if tmpl_arr.shape != loaded.shape:
+            raise ValueError(
+                f"load_params: {prefix or 'root'} shape {loaded.shape} does "
+                f"not match model {tmpl_arr.shape}"
+            )
+        return loaded.astype(tmpl_arr.dtype)
+    if not isinstance(template, dict):
+        raise ValueError(f"load_params: {prefix or 'root'} is a dict in the "
+                         "file but a leaf in the model")
+    unknown = set(loaded) - set(template)
+    if unknown:
+        raise ValueError(
+            f"load_params: keys {sorted(unknown)} under {prefix or 'root'} "
+            f"not present in the model (has {sorted(template)})"
+        )
+    return {
+        k: graft_params(template[k], loaded[k], f"{prefix}/{k}" if prefix else k)
+        if k in loaded else template[k]
+        for k in template
+    }
+
+
 class RoundCheckpointer:
     """Orbax-backed checkpointer; falls back to .npz pytree dumps if orbax is
     unavailable. Layout: <dir>/round_<k>/ with state + meta.json."""

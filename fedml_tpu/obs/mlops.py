@@ -243,7 +243,12 @@ class FedLogs:
                 data = f.read(self.MAX_BYTES_PER_READ)
                 end = data.rfind(b"\n") + 1
                 if end == 0:
-                    break
+                    if len(data) < self.MAX_BYTES_PER_READ:
+                        break  # genuine partial tail — wait for its newline
+                    # a single line longer than the read chunk: ship it as a
+                    # forced newline-less batch so the offset keeps advancing
+                    # (otherwise every later call re-reads this chunk forever)
+                    end = len(data)
                 self._offset += end
                 lines = data[:end].decode(errors="replace").splitlines(keepends=True)
                 for start in range(0, len(lines), self.LOG_LINES_PER_UPLOAD):

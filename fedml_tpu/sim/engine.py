@@ -446,12 +446,21 @@ class FedSim:
         sample.setdefault("mask", jnp.ones((self.config.batch_size,), jnp.float32))
         return self.trainer.init(jax.random.key(self.config.seed), sample)
 
-    def init_round_variables(self) -> Pytree:
+    def init_round_variables(self, overrides: Pytree | None = None) -> Pytree:
         """Model state in the engine's layout: a replicated global model, or —
         per-client mode — an identical-init stacked [C_pad, ...] model set
         sharded over the clients axis (every node starts from the same point,
-        the standard decentralized-optimization setup)."""
+        the standard decentralized-optimization setup).
+
+        ``overrides`` warm-starts collections from a pretrained file
+        (reference resnet.py:202-224): a partial variables dict — e.g.
+        ``{"params": ...}`` from :func:`fedml_tpu.obs.checkpoint.load_params`
+        — grafted over the fresh init before layout."""
         v = self.init_variables()
+        if overrides:
+            from fedml_tpu.obs.checkpoint import graft_params
+
+            v = graft_params(jax.tree.map(np.asarray, dict(v)), dict(overrides))
         if not self._per_client:
             return self._put(v, self._rep)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]

@@ -81,7 +81,7 @@ def test_main_fedgan_smoke(tmp_path):
     assert np.isfinite(hist["Train/Loss"])
 
 
-@pytest.mark.parametrize("backend", ["loopback", "shm"])
+@pytest.mark.parametrize("backend", ["loopback", "shm", "mqtt_s3"])
 def test_cli_backend_message_passing(backend, tmp_path):
     from fedml_tpu.exp.main_fedavg import main
 
@@ -245,3 +245,83 @@ def test_no_dead_cli_flags():
             if uses == 0 and flag not in allowed_noops:
                 offenders.append(f"{p.name}: --{flag}")
     assert not offenders, offenders
+
+
+def test_cli_hetero_fix_partition(tmp_path):
+    """--partition_method hetero-fix round-trips a saved distribution file
+    through the CLI (reference cifar10/data_loader.py:150-158)."""
+    from fedml_tpu.core import partition as P
+    from fedml_tpu.exp.main_fedavg import main
+
+    # the cifar10 synthetic fixture has 2000 train samples
+    parts = {i: np.arange(i * 500, (i + 1) * 500) for i in range(4)}
+    path = tmp_path / "net_dataidx_map.txt"
+    P.write_net_dataidx_map(path, parts)
+    final = main([
+        "--dataset", "cifar10", "--model", "lr",
+        "--partition_method", "hetero-fix", "--dataidx_map_path", str(path),
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--batch_size", "16", "--comm_round", "1", "--epochs", "1",
+        "--frequency_of_the_test", "1", "--run_dir", str(tmp_path),
+    ])
+    assert np.isfinite(final["Train/Loss"])
+    # a bogus path fails loudly
+    with pytest.raises(FileNotFoundError):
+        main([
+            "--dataset", "cifar10", "--model", "lr",
+            "--partition_method", "hetero-fix",
+            "--dataidx_map_path", str(tmp_path / "missing.txt"),
+            "--comm_round", "1", "--run_dir", str(tmp_path),
+        ])
+
+
+def test_cli_mqtt_s3_offloads_model_blobs(tmp_path):
+    """--backend mqtt_s3 really routes model payloads through the object
+    store: with a tiny threshold the FS store fills with blob files while the
+    protocol still converges (reference MQTT_S3,
+    mqtt_s3_multi_clients_comm_manager.py:178-249)."""
+    from fedml_tpu.comm import object_store as oslib
+    from fedml_tpu.exp.main_fedavg import main
+
+    puts = {"n": 0}
+    orig_put = oslib.FileSystemStore.put
+
+    def counting_put(self, key, data):
+        puts["n"] += 1
+        return orig_put(self, key, data)
+
+    oslib.FileSystemStore.put = counting_put
+    store = tmp_path / "store"
+    final = main([
+        "--dataset", "synthetic", "--model", "lr", "--backend", "mqtt_s3",
+        "--object_store_dir", str(store), "--offload_threshold_bytes", "256",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--batch_size", "8", "--comm_round", "3", "--epochs", "1",
+        "--frequency_of_the_test", "3", "--run_dir", str(tmp_path),
+    ])
+    assert final["Test/Acc"] > 0.5
+    # cleanup=True deletes consumed blobs, so count put() calls instead of
+    # files: the model payloads must actually have ridden the store
+    assert puts["n"] > 0
+    oslib.FileSystemStore.put = orig_put
+
+
+def test_cli_message_passing_save_and_warm_start(tmp_path):
+    """--save_params_to / --init_from work on the message-passing backends
+    too (not just the sim engine): save from a loopback run, warm-start
+    another, and the warm run's first eval beats the cold one's."""
+    from fedml_tpu.exp.main_fedavg import main
+
+    p = tmp_path / "warm.npz"
+    base = [
+        "--dataset", "synthetic", "--model", "lr", "--backend", "loopback",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--batch_size", "8", "--epochs", "1", "--frequency_of_the_test", "1",
+    ]
+    main(base + ["--comm_round", "3", "--run_dir", str(tmp_path / "a"),
+                 "--save_params_to", str(p)])
+    assert p.exists()
+    cold = main(base + ["--comm_round", "1", "--run_dir", str(tmp_path / "b")])
+    warm = main(base + ["--comm_round", "1", "--run_dir", str(tmp_path / "c"),
+                        "--init_from", str(p)])
+    assert warm["Test/Acc"] >= cold["Test/Acc"], (warm, cold)

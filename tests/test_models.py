@@ -260,3 +260,36 @@ def test_cv_zoo_bf16_compute():
             for l in jax.tree.leaves(v["params"])
         ), type(model).__name__
         assert np.isfinite(np.asarray(out)).all(), type(model).__name__
+
+
+def test_resnet_f32_vs_bf16_accuracy_parity():
+    """bf16 compute (the bench headline numerics, bench.py) matches f32
+    training accuracy on the ResNet family: same data, same recipe, both must
+    learn the task and land within a few points of each other."""
+    import numpy as np
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.resnet import CifarResNet
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(n_clients=4, samples_per_client=32,
+                                 num_classes=4, dim=8 * 8 * 3, seed=5)
+    for arrays in (train.arrays, test):
+        arrays["x"] = arrays["x"].reshape(-1, 8, 8, 3)
+
+    accs = {}
+    for name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        tr = ClientTrainer(
+            module=CifarResNet(depth=8, num_classes=4, dtype=dtype),
+            optimizer=optax.sgd(0.1, momentum=0.9), epochs=1,
+        )
+        cfg = SimConfig(client_num_in_total=4, client_num_per_round=4,
+                        batch_size=16, comm_round=8, epochs=1,
+                        frequency_of_the_test=8, seed=0)
+        _, hist = FedSim(tr, train, test, cfg).run()
+        accs[name] = hist[-1]["Test/Acc"]
+    assert accs["f32"] > 0.85, accs
+    assert accs["bf16"] > 0.85, accs
+    assert abs(accs["f32"] - accs["bf16"]) < 0.1, accs

@@ -55,3 +55,40 @@ def test_dispatch():
         _check_disjoint_cover(parts, len(LABELS))
     with pytest.raises(ValueError):
         P.partition("bogus", LABELS, 5)
+
+
+def test_net_dataidx_map_txt_roundtrip(tmp_path):
+    """hetero-fix file round-trip in the reference's printed-dict layout
+    (cifar10/data_loader.py:31-43)."""
+    parts = P.dirichlet_partition(LABELS, 6, alpha=0.5, seed=2)
+    path = tmp_path / "net_dataidx_map.txt"
+    P.write_net_dataidx_map(path, parts)
+    loaded = P.read_net_dataidx_map(path)
+    assert set(loaded) == set(parts)
+    for c in parts:
+        np.testing.assert_array_equal(loaded[c], parts[c])
+
+
+def test_net_dataidx_map_json(tmp_path):
+    import json
+
+    path = tmp_path / "map.json"
+    path.write_text(json.dumps({"0": [3, 1, 2], "1": [0, 4]}))
+    loaded = P.read_net_dataidx_map(path)
+    np.testing.assert_array_equal(loaded[0], [3, 1, 2])
+    np.testing.assert_array_equal(loaded[1], [0, 4])
+
+
+def test_hetero_fix_dispatch(tmp_path):
+    parts = P.homo_partition(len(LABELS), 4, seed=0)
+    path = tmp_path / "net_dataidx_map.txt"
+    P.write_net_dataidx_map(path, parts)
+    loaded = P.partition("hetero-fix", LABELS, 4, dataidx_map_path=path)
+    _check_disjoint_cover(loaded, len(LABELS))
+    # missing path is a loud error, not a silent fallback
+    with pytest.raises(ValueError, match="dataidx_map_path"):
+        P.partition("hetero-fix", LABELS, 4)
+    # indices outside the dataset are rejected
+    P.write_net_dataidx_map(path, {0: np.asarray([0, len(LABELS) + 7])})
+    with pytest.raises(ValueError, match="outside"):
+        P.partition("hetero-fix", LABELS, 1, dataidx_map_path=path)
