@@ -20,12 +20,21 @@ plaintext models. Here the secure path actually runs:
    share-sum.
 4. The server Lagrange-reconstructs the weighted-mean delta from
    threshold+1 share-sums and applies it to the global model. Every
-   share-sum already contains ALL clients' updates, so clients that die
-   after the share-exchange leg but before uploading cost nothing: with
+   share-sum already contains its inclusion set's updates, so clients that
+   die after the share-exchange leg but before uploading cost nothing: with
    ``round_timeout`` set, the server reconstructs the full aggregate from
-   whichever >= threshold+1 share-sums arrived. (A client that dies before
-   sending its peer shares stalls the round — recovering from that requires
-   the full SecAgg mask-recovery protocol, out of scope here.)
+   whichever >= threshold+1 share-sums arrived.
+5. Pre-share dropout recovery (``share_timeout``): a client that dies
+   BEFORE sending its peer shares would leave everyone waiting, so clients
+   whose share wait times out report (clear metadata only) which peers'
+   shares they hold; the server intersects the reports into an agreed
+   inclusion set and broadcasts it; clients then submit share-sums over
+   exactly that subset. Share-sums carry their inclusion set and the server
+   reconstructs only within the largest same-set bucket — sums over
+   different subsets are shares of different polynomials and are never
+   mixed — then renormalizes by the included weight mass. This is subset
+   consistency, not SecAgg mask recovery: simpler, and sufficient because
+   BGW shares (unlike pairwise masks) need no per-dropout unmasking.
 
 Privacy: the server sees only the aggregate; a coalition of <= threshold
 clients learns nothing about another client's update (Shamir). Exactness:
@@ -66,6 +75,9 @@ class TAMessage:
     MSG_TYPE_C2S_REGISTER = 3      # clear-text sample count n_i
     MSG_TYPE_C2C_SHARE = 4         # BGW share leg: client -> client
     MSG_TYPE_C2S_SHARE_SUM = 5     # masked aggregate leg: client -> server
+    # pre-share dropout recovery (subset consistency, see class docstring)
+    MSG_TYPE_C2S_SHARE_REPORT = 6  # clear metadata: which peers' shares arrived
+    MSG_TYPE_S2C_INCLUDE = 7       # server-agreed inclusion set
 
     KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
     KEY_DESC = "model_desc"
@@ -73,6 +85,8 @@ class TAMessage:
     KEY_SHARE = "bgw_share"
     KEY_ROUND = "round_idx"
     KEY_WEIGHT = "p_i"  # this client's normalized aggregation weight
+    KEY_HOLDERS = "holders"        # share report: ranks whose shares I hold
+    KEY_INCLUDE = "include_set"    # ranks whose updates a share-sum includes
 
 
 def _check_threshold(threshold: int, worker_num: int) -> int:
@@ -110,7 +124,13 @@ class TAServerManager(ServerManager):
         self.round_timeout = round_timeout
         self.on_round_done = on_round_done
         self._sample_nums: dict[int, float] = {}
-        self._share_sums: dict[int, np.ndarray] = {}
+        # sender -> (include_set_tuple, share_sum): share-sums over different
+        # inclusion sets are shares of DIFFERENT polynomials and must never
+        # be mixed in one reconstruction
+        self._share_sums: dict[int, tuple[tuple[int, ...], np.ndarray]] = {}
+        self._reports: dict[int, tuple[int, ...]] = {}
+        self._include_sent = False
+        self._timed_out = False
         self._timer: threading.Timer | None = None
         self._lock = threading.Lock()
 
@@ -127,6 +147,9 @@ class TAServerManager(ServerManager):
         )
         self.register_message_receive_handler(
             TAMessage.MSG_TYPE_C2S_SHARE_SUM, self._on_share_sum
+        )
+        self.register_message_receive_handler(
+            TAMessage.MSG_TYPE_C2S_SHARE_REPORT, self._on_share_report
         )
 
     # -- registration: collect n_i, broadcast p_i ---------------------------
@@ -157,25 +180,99 @@ class TAServerManager(ServerManager):
         with self._lock:
             if int(msg.get(TAMessage.KEY_ROUND)) != self.round_idx:
                 return  # late arrival from a timed-out round
-            self._share_sums[msg.get_sender_id()] = np.asarray(
-                msg.get(TAMessage.KEY_SHARE)
+            include = msg.get(TAMessage.KEY_INCLUDE)
+            include = (
+                tuple(int(i) for i in include) if include is not None
+                else tuple(range(1, self.worker_num + 1))
+            )
+            self._share_sums[msg.get_sender_id()] = (
+                include, np.asarray(msg.get(TAMessage.KEY_SHARE))
             )
             got = len(self._share_sums)
-            if got == 1 and self.round_timeout is not None:
-                # every share-sum carries ALL clients' updates; after the
-                # timeout any threshold+1 of them reconstruct the aggregate
-                self._timed_out = False
+            if (got == 1 and self.round_timeout is not None
+                    and self._timer is None and not self._timed_out):
+                # every share-sum carries its whole inclusion set's updates;
+                # after the timeout any threshold+1 same-set share-sums
+                # reconstruct the aggregate. Never re-arm (or reset
+                # _timed_out) once a recovery timer already fired — the
+                # post-include share-sums must close at t+1 immediately,
+                # not after a second full round_timeout
                 self._timer = threading.Timer(self.round_timeout, self._timeout)
                 self._timer.daemon = True
                 self._timer.start()
             if got < self.worker_num and not (
-                getattr(self, "_timed_out", False) and got >= self.threshold + 1
+                self._timed_out and got >= self.threshold + 1
             ):
                 return
         self._close_round()
 
+    def _on_share_report(self, msg: Message) -> None:
+        """Pre-share dropout recovery, leg 1: a client whose share wait timed
+        out reports (clear metadata only) which peers' shares it holds. Once
+        every live worker has either submitted or reported, broadcast the
+        intersection as the agreed inclusion set — every reporter holds all
+        of it, so all share-sums land in one reconstructable bucket."""
+        with self._lock:
+            if int(msg.get(TAMessage.KEY_ROUND)) != self.round_idx:
+                return
+            self._reports[msg.get_sender_id()] = tuple(
+                int(i) for i in msg.get(TAMessage.KEY_HOLDERS)
+            )
+            covered = set(self._reports) | set(self._share_sums)
+            if self._include_sent:
+                return
+            # decide as soon as every rank is accounted for, or — with dead
+            # clients that will never speak — when the reporters alone could
+            # reconstruct (they are the live set)
+            if len(covered) < self.worker_num and not (
+                len(self._reports) >= self.threshold + 1 and self._timed_out
+            ):
+                # arm the dead-rank-declaring timer even when the caller set
+                # no round_timeout: a pre-share drop would otherwise wait
+                # forever for the dead rank's report (the exact stall the
+                # share_timeout feature exists to prevent)
+                if self._timer is None and not self._timed_out:
+                    grace = self.round_timeout if self.round_timeout is not None else 5.0
+                    self._timer = threading.Timer(grace, self._timeout)
+                    self._timer.daemon = True
+                    self._timer.start()
+                return
+            include = sorted(set.intersection(
+                *(set(h) for h in self._reports.values())
+            ))
+            self._include_sent = True
+            reporters = sorted(self._reports)
+        logging.info(
+            "turboaggregate round %d: share dropout — inclusion set %s "
+            "agreed from %d reports", self.round_idx, include, len(reporters)
+        )
+        for w in reporters:
+            m = Message(TAMessage.MSG_TYPE_S2C_INCLUDE, 0, w)
+            m.add_params(TAMessage.KEY_ROUND, self.round_idx)
+            m.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
+            self.send_message(m)
+
     def _timeout(self) -> None:
         self._timed_out = True
+        # if clients reported a share dropout, the timer's job is to declare
+        # the silent ranks dead and broadcast the inclusion set — the
+        # incoming share-sums then close the round normally
+        with self._lock:
+            if self._reports and not self._include_sent:
+                include = sorted(set.intersection(
+                    *(set(h) for h in self._reports.values())
+                ))
+                self._include_sent = True
+                reporters = sorted(self._reports)
+            else:
+                reporters = None
+        if reporters is not None:
+            for w in reporters:
+                m = Message(TAMessage.MSG_TYPE_S2C_INCLUDE, 0, w)
+                m.add_params(TAMessage.KEY_ROUND, self.round_idx)
+                m.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
+                self.send_message(m)
+            return
         self._close_round()
 
     def _close_round(self) -> None:
@@ -185,11 +282,18 @@ class TAServerManager(ServerManager):
                 # timer's _timed_out flag must not leak into the next round
                 self._timed_out = False
                 return
-            if len(self._share_sums) < self.threshold + 1:
+            # share-sums over different inclusion sets are shares of
+            # different polynomials: reconstruct from the largest same-set
+            # bucket only
+            buckets: dict[tuple[int, ...], list[int]] = {}
+            for sender, (include, _) in self._share_sums.items():
+                buckets.setdefault(include, []).append(sender)
+            include, bucket = max(buckets.items(), key=lambda kv: len(kv[1]))
+            if len(bucket) < self.threshold + 1:
                 logging.error(
-                    "turboaggregate round %d: only %d/%d share-sums after "
-                    "timeout (< t+1=%d) — cannot reconstruct; waiting on",
-                    self.round_idx, len(self._share_sums), self.worker_num,
+                    "turboaggregate round %d: largest same-set bucket has "
+                    "%d/%d share-sums (< t+1=%d) — cannot reconstruct; waiting",
+                    self.round_idx, len(bucket), self.worker_num,
                     self.threshold + 1,
                 )
                 return
@@ -198,11 +302,20 @@ class TAServerManager(ServerManager):
             # round check the moment we commit to reconstructing (the timer
             # thread and the receive thread race here when round_timeout is
             # set)
-            share_sums = dict(self._share_sums)
+            share_sums = {s: self._share_sums[s][1] for s in bucket}
             self._share_sums.clear()
+            self._reports.clear()
+            self._include_sent = False
             closed_round = self.round_idx
             self.round_idx += 1
             self._timed_out = False
+            total = sum(self._sample_nums.values())
+            # the bucket's aggregate is sum_{i in include} p_i * delta_i;
+            # renormalize by the included weight mass so dropped clients
+            # don't shrink the update (clear metadata, no privacy cost)
+            w_mass = sum(
+                self._sample_nums.get(i, 0.0) / total for i in include
+            ) or 1.0
             if self._timer is not None:
                 self._timer.cancel()
                 self._timer = None
@@ -210,7 +323,7 @@ class TAServerManager(ServerManager):
         shares = np.stack([share_sums[s] for s in senders])
         share_idx = np.asarray(senders) - 1  # rank w holds eval point w
         summed = bgw_decode(shares, share_idx, self.prime)
-        mean_delta = dequantize(summed, self.scale, self.prime)
+        mean_delta = dequantize(summed, self.scale, self.prime) / w_mass
         new_flat = (
             self.global_flat.view(np.float32).astype(np.float64) + mean_delta
         ).astype(np.float32)
@@ -230,7 +343,7 @@ class TAClientManager(ClientManager):
                  trainer: ClientTrainer, train_data: FederatedArrays,
                  batch_size: int, threshold: int | None = None,
                  scale: float = 2**16, prime: int = DEFAULT_PRIME, seed: int = 0,
-                 local_train_fn=None):
+                 local_train_fn=None, share_timeout: float | None = None):
         super().__init__(comm, rank, size)
         self.worker_num = size - 1
         self.trainer = trainer
@@ -254,11 +367,18 @@ class TAClientManager(ClientManager):
         self._peer_shares: dict[int, dict[int, np.ndarray]] = {}
         self._submitted: set[int] = set()
         self._p_i: float | None = None
+        # pre-share dropout recovery: if a peer's share hasn't arrived
+        # share_timeout seconds after our own shares went out, report the
+        # holders we DO have and wait for the server's inclusion set
+        self.share_timeout = share_timeout
+        self._share_timers: dict[int, threading.Timer] = {}
+        self._include: dict[int, tuple[int, ...]] = {}
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(TAMessage.MSG_TYPE_S2C_INIT, self._on_init)
         self.register_message_receive_handler(TAMessage.MSG_TYPE_S2C_SYNC, self._on_sync)
         self.register_message_receive_handler(TAMessage.MSG_TYPE_C2C_SHARE, self._on_peer_share)
+        self.register_message_receive_handler(TAMessage.MSG_TYPE_S2C_INCLUDE, self._on_include)
 
     # -- round legs ----------------------------------------------------------
 
@@ -311,6 +431,13 @@ class TAClientManager(ClientManager):
             m.add_params(TAMessage.KEY_SHARE, shares[peer - 1])
             m.add_params(TAMessage.KEY_ROUND, round_idx)
             self.send_message(m)
+        if self.share_timeout is not None:
+            t = threading.Timer(self.share_timeout,
+                                self._report_holders, args=(round_idx,))
+            t.daemon = True
+            with self._lock:
+                self._share_timers[round_idx] = t
+            t.start()
         self._maybe_submit(round_idx)
 
     def _on_peer_share(self, msg: Message) -> None:
@@ -322,21 +449,54 @@ class TAClientManager(ClientManager):
             )
         self._maybe_submit(round_idx)
 
+    def _on_include(self, msg: Message) -> None:
+        round_idx = int(msg.get(TAMessage.KEY_ROUND))
+        with self._lock:
+            self._include[round_idx] = tuple(
+                int(i) for i in msg.get(TAMessage.KEY_INCLUDE)
+            )
+        self._maybe_submit(round_idx)
+
+    def _report_holders(self, round_idx: int) -> None:
+        """Share wait timed out: report (clear metadata) which peers' shares
+        arrived; the server intersects reports into an inclusion set."""
+        with self._lock:
+            if round_idx in self._submitted:
+                return
+            holders = sorted(self._peer_shares.get(round_idx, {}))
+        out = Message(TAMessage.MSG_TYPE_C2S_SHARE_REPORT, self.rank, 0)
+        out.add_params(TAMessage.KEY_HOLDERS, np.asarray(holders, np.int64))
+        out.add_params(TAMessage.KEY_ROUND, round_idx)
+        self.send_message(out)
+
     def _stash_share(self, round_idx: int, sender: int, share: np.ndarray) -> None:
         self._peer_shares.setdefault(round_idx, {})[sender] = share
 
     def _maybe_submit(self, round_idx: int) -> None:
         with self._lock:
             got = self._peer_shares.get(round_idx, {})
-            if len(got) < self.worker_num or round_idx in self._submitted:
+            if round_idx in self._submitted:
                 return
+            include = tuple(range(1, self.worker_num + 1))
+            if len(got) < self.worker_num:
+                # partial shares: only submit once the server has fixed the
+                # inclusion set and we hold every share in it
+                agreed = self._include.get(round_idx)
+                if agreed is None or not set(agreed) <= set(got):
+                    return
+                include = agreed
             self._submitted.add(round_idx)
-            stack = np.stack([got[s] for s in sorted(got)])
+            stack = np.stack([got[s] for s in include])
             del self._peer_shares[round_idx]
+            self._include.pop(round_idx, None)
+            timer = self._share_timers.pop(round_idx, None)
+        if timer is not None:
+            timer.cancel()
         share_sum = stack.sum(axis=0) % self.prime
         out = Message(TAMessage.MSG_TYPE_C2S_SHARE_SUM, self.rank, 0)
         out.add_params(TAMessage.KEY_SHARE, share_sum)
         out.add_params(TAMessage.KEY_ROUND, round_idx)
+        out.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
         self.send_message(out)
 
 
@@ -351,6 +511,7 @@ def run_turboaggregate(
     scale: float = 2**16,
     seed: int = 0,
     round_timeout: float | None = None,
+    share_timeout: float | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
 ):
     """End-to-end secure aggregation over any comm fabric (same harness
@@ -383,7 +544,7 @@ def run_turboaggregate(
         TAClientManager(
             make_comm(r), r, worker_num + 1, trainer, train_data, batch_size,
             threshold=threshold, scale=scale, seed=seed,
-            local_train_fn=shared_local_train,
+            local_train_fn=shared_local_train, share_timeout=share_timeout,
         )
         for r in range(1, worker_num + 1)
     ]

@@ -81,8 +81,10 @@ class InProcessBroker:
             def __init__(self, client_id: str = "", protocol=None):
                 self.client_id = client_id
                 self.on_connect = None
+                self.on_subscribe = None
                 self.on_message = None
                 self._connected = False
+                self._mid = 0
 
             def will_set(self, topic, payload, qos=0, retain=False):
                 broker.set_will(self, topic, payload)
@@ -97,6 +99,10 @@ class InProcessBroker:
 
             def subscribe(self, topic, qos=0):
                 broker.subscribe(topic, self)
+                # registration is synchronous here; ack it like a SUBACK
+                self._mid += 1
+                if self.on_subscribe is not None:
+                    self.on_subscribe(self, None, self._mid, (qos,))
 
             def publish(self, topic, payload, qos=0, retain=False):
                 broker.publish(topic, payload)

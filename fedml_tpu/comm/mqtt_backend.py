@@ -74,20 +74,24 @@ class MqttCommManager(BaseCommunicationManager):
             qos=1, retain=False,
         )
         self._subscribed = threading.Event()
+        self._expected_subacks = max(self.client_num, 1) if client_id == 0 else 1
+        self._suback_count = 0
         self.client.on_connect = self._on_connect
+        self.client.on_subscribe = self._on_subscribe
         self.client.on_message = self._on_message
         self.client.connect(host, port, keepalive)
         self.client.loop_start()
-        # Block until our subscriptions are registered: with a real broker,
-        # CONNACK-driven _on_connect runs on paho's network thread, and a
-        # QoS1 non-retained publish to a topic with no subscriber yet is
-        # silently dropped — the protocol's init broadcast would vanish and
-        # the run would hang. Construction-order guarantee: every manager's
-        # constructor returns only after its own subscribe, so init messages
-        # sent after all managers exist always have their subscribers.
+        # Block until the broker ACKNOWLEDGES our subscriptions (SUBACK via
+        # on_subscribe — subscribe() only queues the packet): a QoS1
+        # non-retained publish to a topic whose subscription the broker has
+        # not registered yet is silently dropped, so the protocol's init
+        # broadcast could vanish and hang the run. Construction-order
+        # guarantee: every manager's constructor returns only after its own
+        # subscriptions are live, so init messages sent after all managers
+        # exist always have their subscribers.
         if not self._subscribed.wait(timeout=30.0):
             raise TimeoutError(
-                f"mqtt: no CONNACK/subscribe within 30 s (broker {host}:{port})"
+                f"mqtt: no SUBACK within 30 s (broker {host}:{port})"
             )
 
     # topic scheme (mqtt_comm_manager.py:47-70)
@@ -113,7 +117,11 @@ class MqttCommManager(BaseCommunicationManager):
             json.dumps({"id": self.client_id, "status": "ONLINE"}),
             qos=1,
         )
-        self._subscribed.set()
+
+    def _on_subscribe(self, client, userdata, mid, granted_qos, properties=None):
+        self._suback_count += 1
+        if self._suback_count >= self._expected_subacks:
+            self._subscribed.set()
 
     def _on_message(self, client, userdata, mqtt_msg):
         try:

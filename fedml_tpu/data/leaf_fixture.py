@@ -71,11 +71,24 @@ def write_leaf_mnist_fixture(
     """Write LEAF-format train/ test/ JSON dirs; returns out_dir.
 
     Power-law sizes (lognormal, the FedProx MNIST recipe), 2 classes per
-    client, 90/10 train/test split per client. Idempotent: skips if the
-    train dir already has json.
+    client, 90/10 train/test split per client. Idempotency, real-data
+    preservation, and stale regeneration follow the shared
+    :mod:`fedml_tpu.data.fixture_util` contract.
     """
+    from fedml_tpu.data import fixture_util
+
     out = Path(out_dir)
-    if (out / "train").is_dir() and any((out / "train").glob("*.json")):
+    names = [f"{split}/all_data_niid_0_keep_0_{split}_9.json"
+             for split in ("train", "test")]
+    if (out / "train").is_dir() and any((out / "train").glob("*.json")) \
+            and not fixture_util.is_fixture(out, "mnist"):
+        return out  # real LEAF json — never touched
+    if not fixture_util.prepare(
+        out, "mnist",
+        {"n_clients": n_clients, "seed": seed,
+         "min_samples": min_samples, "max_samples": max_samples},
+        names,
+    ):
         return out
     rng = np.random.RandomState(seed)
     pools = _digit_pools(seed)
@@ -107,9 +120,4 @@ def write_leaf_mnist_fixture(
         d.mkdir(parents=True, exist_ok=True)
         with open(d / f"all_data_niid_0_keep_0_{split}_9.json", "w") as f:
             json.dump(blob, f)
-    # marker last (after the data exists) so consumers can never mistake the
-    # fixture for real LEAF MNIST, and a crash mid-generation leaves no marker
-    (out / FIXTURE_MARKER).write_text(
-        "generated by fedml_tpu.data.leaf_fixture — NOT real LEAF MNIST\n"
-    )
     return out

@@ -24,7 +24,7 @@ from pathlib import Path
 
 import numpy as np
 
-from fedml_tpu.data.leaf_fixture import FIXTURE_MARKER, _digit_pools
+from fedml_tpu.data.leaf_fixture import _digit_pools
 
 
 def _writer_samples(pools, n, rng):
@@ -59,32 +59,20 @@ def write_femnist_h5_fixture(
     """Write fed_emnist_train.h5 / fed_emnist_test.h5; returns out_dir.
 
     Lognormal per-writer sample counts, 90/10 train/test split per writer.
-    Idempotent: skips when the train archive already exists AND the marker
-    records the same (n_clients, seed); a mismatched marker (or a fixture
-    left by an older version without config in the marker) regenerates, so
-    rerunning with a different client count or seed never silently reuses a
-    stale fixture. Pixels stored float32 in [0, 1] like the real TFF archive.
+    Idempotency, real-data preservation, and stale-config regeneration are
+    the shared :mod:`fedml_tpu.data.fixture_util` contract. Pixels stored
+    float32 in [0, 1] like the real TFF archive.
     """
-    import json
-
     import h5py
 
+    from fedml_tpu.data import fixture_util
+
     out = Path(out_dir)
-    config_line = json.dumps({"n_clients": n_clients, "seed": seed})
-    marker = out / FIXTURE_MARKER
-    if (out / "fed_emnist_train.h5").exists():
-        if not marker.exists():
-            # archives this generator did not write (no marker) are REAL
-            # data — never delete them; the caller decides what to load
-            return out
-        lines = marker.read_text().splitlines()
-        if lines and lines[-1] == config_line:
-            return out
-        # a fixture from an older config: regenerate for this one
-        for stale in ("fed_emnist_train.h5", "fed_emnist_test.h5"):
-            (out / stale).unlink(missing_ok=True)
-        marker.unlink(missing_ok=True)
-    out.mkdir(parents=True, exist_ok=True)
+    if not fixture_util.prepare(
+        out, "femnist", {"n_clients": n_clients, "seed": seed},
+        ["fed_emnist_train.h5", "fed_emnist_test.h5"],
+    ):
+        return out
     rng = np.random.RandomState(seed)
     pools = _digit_pools(seed)
     sizes = np.clip(
@@ -104,13 +92,65 @@ def write_femnist_h5_fixture(
                 g = grp.create_group(cid)
                 g.create_dataset("pixels", data=x[sl], compression="gzip")
                 g.create_dataset("label", data=y[sl].astype(np.int64))
-    # marker BEFORE the renames: idempotency keys on the train archive, so an
-    # early marker is harmless, while a crash between renames and a late
-    # marker write would leave archives that read as real data
-    marker.write_text(
-        "generated by fedml_tpu.data.tff_fixture — NOT real FederatedEMNIST\n"
-        + config_line + "\n"
-    )
     tmp_train.rename(out / "fed_emnist_train.h5")
     tmp_test.rename(out / "fed_emnist_test.h5")
+    return out
+
+
+def write_fed_cifar100_h5_fixture(
+    out_dir: str | Path,
+    n_train_clients: int = 500,
+    n_test_clients: int = 100,
+    samples_per_client: int = 100,
+    seed: int = 0,
+) -> Path:
+    """Write fed_cifar100_{train,test}.h5 in the real TFF schema
+    (``examples/<client>/image|label``, fed_cifar100/data_loader.py:105).
+
+    Offline stand-in for GLD-downloaded archives: 100 class-blob RGB classes,
+    per-client class skew drawn from a Dirichlet (the real archive's Pachinko
+    allocation is also a per-client class-mixture; this keeps the non-IID
+    shape without the LDA tree). NOT real CIFAR-100 — REPRO.md says so.
+    Idempotency/real-data preservation follow the shared
+    :mod:`fedml_tpu.data.fixture_util` contract.
+    """
+    import h5py
+
+    from fedml_tpu.data import fixture_util
+
+    out = Path(out_dir)
+    if not fixture_util.prepare(
+        out, "fed_cifar100",
+        {"n_train_clients": n_train_clients, "n_test_clients": n_test_clients,
+         "samples_per_client": samples_per_client, "seed": seed},
+        ["fed_cifar100_train.h5", "fed_cifar100_test.h5"],
+    ):
+        return out
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(100, 32, 32, 3).astype(np.float32)
+
+    def client_samples(n):
+        # per-client class mixture: a few dominant classes (non-IID)
+        probs = rng.dirichlet(np.full(100, 0.1))
+        ys = rng.choice(100, size=n, p=probs).astype(np.int64)
+        xs = np.clip(centers[ys] + rng.normal(0, 0.25, (n, 32, 32, 3)), 0, 1)
+        return (xs * 255).astype(np.uint8), ys
+
+    tmp_train = out / "fed_cifar100_train.h5.tmp"
+    tmp_test = out / "fed_cifar100_test.h5.tmp"
+    with h5py.File(tmp_train, "w") as ftr, h5py.File(tmp_test, "w") as fte:
+        gtr = ftr.create_group("examples")
+        gte = fte.create_group("examples")
+        for ci in range(n_train_clients):
+            x, y = client_samples(samples_per_client)
+            g = gtr.create_group(f"c{ci:05d}")
+            g.create_dataset("image", data=x, compression="gzip")
+            g.create_dataset("label", data=y)
+        for ci in range(n_test_clients):
+            x, y = client_samples(samples_per_client)
+            g = gte.create_group(f"c{ci:05d}")
+            g.create_dataset("image", data=x, compression="gzip")
+            g.create_dataset("label", data=y)
+    tmp_train.rename(out / "fed_cifar100_train.h5")
+    tmp_test.rename(out / "fed_cifar100_test.h5")
     return out

@@ -1,0 +1,50 @@
+"""Shared resilient round loop for the BASELINE repro scripts.
+
+Drives ``FedSim`` one round-dispatch at a time (instead of the engine's
+eval-block scan): long multi-round programs wedged the tunneled TPU worker
+during the cross-silo flagship run, and per-round dispatch also lets a
+crash mid-run still produce a truthful partial report. ``round_sleep``
+inserts an idle gap between dispatches — needed for recipes whose single
+round runs tens of seconds (the tunnel wedged twice on sustained
+back-to-back 45 s executes), pointless for sub-second rounds.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+
+def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0) -> tuple[list, float]:
+    """Returns (records, wall_seconds). On an exception the loop stops and
+    whatever completed is returned — callers report partial results."""
+    from fedml_tpu.core import rng as rnglib
+
+    records: list[dict] = []
+    variables = sim.init_round_variables()
+    server_state = sim.aggregator.init_state(variables)
+    root = rnglib.root_key(cfg.seed)
+    freq = max(cfg.frequency_of_the_test, 1)
+    t0 = time.time()
+    with open(metrics_out, "w") as f:
+        for r in range(cfg.comm_round):
+            try:
+                variables, server_state, m = sim.run_round(
+                    r, variables, server_state, root
+                )
+                rec = {"round": r, **{k: float(v) for k, v in m.items()}}
+                if (r + 1) % freq == 0 or r == cfg.comm_round - 1:
+                    rec.update(sim.eval_record(variables))
+            except Exception:
+                logging.exception(
+                    "round %d failed — reporting the %d completed rounds",
+                    r, len(records),
+                )
+                break
+            records.append(rec)
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            if round_sleep:
+                time.sleep(round_sleep)
+    return records, (time.time() - t0) or 1.0

@@ -29,7 +29,7 @@ from pathlib import Path
 
 import numpy as np
 
-from fedml_tpu.data.leaf_fixture import FIXTURE_MARKER
+from fedml_tpu.data import fixture_util
 
 
 def write_cifar10_fixture(out_dir: str | Path, n_train: int = 50_000,
@@ -37,30 +37,19 @@ def write_cifar10_fixture(out_dir: str | Path, n_train: int = 50_000,
     """Write class-blob images in the real CIFAR-10 batch format
     (5 x data_batch_i + test_batch pickles of uint8 [N, 3072] rows).
 
-    Idempotent keyed on (n_train, n_test, seed) recorded in the marker:
-    a rerun with a different seed regenerates instead of silently reusing
-    the old fixture. Batches without a marker are REAL data and are never
-    touched."""
-    out = Path(out_dir) / "cifar-10-batches-py"
-    marker = out.parent / FIXTURE_MARKER
-    config_line = json.dumps({"n_train": n_train, "n_test": n_test, "seed": seed})
-    if (out / "data_batch_1").exists():
-        if not marker.exists():
-            return out  # real batches — leave them alone
-        lines = marker.read_text().splitlines()
-        if lines and lines[-1] == config_line:
-            return out
-        for i in range(1, 6):
-            (out / f"data_batch_{i}").unlink(missing_ok=True)
-        (out / "test_batch").unlink(missing_ok=True)
-        marker.unlink(missing_ok=True)
+    Idempotency, real-data preservation, and stale regeneration follow the
+    shared :mod:`fedml_tpu.data.fixture_util` contract; data files land via
+    tmp+rename so a crash mid-generation never leaves a half-fixture that a
+    matching marker would pin forever."""
+    sub = "cifar-10-batches-py"
+    names = [f"{sub}/data_batch_{i}" for i in range(1, 6)] + [f"{sub}/test_batch"]
+    out = Path(out_dir) / sub
+    if not fixture_util.prepare(
+        out_dir, "cifar10",
+        {"n_train": n_train, "n_test": n_test, "seed": seed}, names,
+    ):
+        return out
     out.mkdir(parents=True, exist_ok=True)
-    # marker first: idempotency keys on data_batch_1, so an early marker is
-    # harmless while a late one could mislabel a half-written fixture as real
-    marker.write_text(
-        "generated by fedml_tpu.exp.repro_cross_silo — NOT real CIFAR-10\n"
-        + config_line + "\n"
-    )
     rng = np.random.RandomState(seed)
     centers = rng.rand(10, 32, 32, 3).astype(np.float32)
 
@@ -72,13 +61,15 @@ def write_cifar10_fixture(out_dir: str | Path, n_train: int = 50_000,
         return rows, y
 
     per = n_train // 5
-    for i in range(1, 6):
-        rows, y = make(per)
-        with open(out / f"data_batch_{i}", "wb") as fh:
+    tmp_final = []
+    for name, n in [(f"data_batch_{i}", per) for i in range(1, 6)] + [("test_batch", n_test)]:
+        rows, y = make(n)
+        tmp = out / (name + ".tmp")
+        with open(tmp, "wb") as fh:
             pickle.dump({b"data": rows, b"labels": y.tolist()}, fh)
-    rows, y = make(n_test)
-    with open(out / "test_batch", "wb") as fh:
-        pickle.dump({b"data": rows, b"labels": y.tolist()}, fh)
+        tmp_final.append((tmp, out / name))
+    for tmp, final in tmp_final:
+        tmp.rename(final)
     return out
 
 
@@ -104,7 +95,7 @@ def run(args) -> dict:
     real = (
         ((data_dir / "cifar-10-batches-py" / "data_batch_1").exists()
          or (data_dir / "data_batch_1").exists())
-        and not (data_dir / FIXTURE_MARKER).exists()
+        and not fixture_util.is_fixture(data_dir, "cifar10")
     )
     if not real:
         logging.info("no real CIFAR-10 under %s — using offline fixture", data_dir)
@@ -149,18 +140,14 @@ def run(args) -> dict:
     )
     sim = FedSim(trainer, train, test, cfg, mesh=mesh)
 
-    records = []
-    t0 = time.time()
-    with open(args.metrics_out, "w") as f:
-        def cb(rec):
-            records.append(rec)
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
+    from fedml_tpu.exp._loop import run_rounds
 
-        sim.run(callback=cb)
-    wall = time.time() - t0
+    records, wall = run_rounds(sim, cfg, args.metrics_out,
+                               round_sleep=args.round_sleep)
 
     evals = [r for r in records if "Test/Acc" in r]
+    if not evals:
+        raise RuntimeError("no completed eval rounds — nothing to report")
     best = max(e["Test/Acc"] for e in evals)
     result = {
         "dataset": "real CIFAR-10" if real else "offline CIFAR-format fixture",
@@ -170,10 +157,11 @@ def run(args) -> dict:
         "clients": args.client_num_in_total,
         "batch_size": args.batch_size,
         "local_epochs": args.epochs,
-        "rounds": cfg.comm_round,
+        "rounds": len(records),
+        "rounds_requested": cfg.comm_round,
         "best_test_acc": round(best, 4),
         "final_test_acc": round(evals[-1]["Test/Acc"], 4),
-        "rounds_per_sec": round(cfg.comm_round / wall, 4),
+        "rounds_per_sec": round(len(records) / wall, 4),
         "wall_clock_sec": round(wall, 1),
         "mesh": {CLIENT_AXIS: int(devices.size // silo), SILO_AXIS: int(silo)},
     }
@@ -245,6 +233,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--comm_round", type=int, default=100)
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--round_sleep", type=float, default=2.0,
+                        help="idle gap between round dispatches (tunnel "
+                             "stability; see run())")
     parser.add_argument("--metrics_out", type=str, default="repro_cross_silo_metrics.jsonl")
     parser.add_argument("--out", type=str, default="REPRO.md")
     return parser

@@ -1,0 +1,163 @@
+"""BASELINE reproduction: fed_cifar100 + ResNet18-GN, shallow-NN table row.
+
+Reference config (benchmark/README.md:54-57; BASELINE.md): CIFAR-100
+federated (500 clients, Pachinko allocation), ResNet-18 with GroupNorm
+(the Adaptive-FedOpt paper config, model/cv/resnet_gn.py:183), 10
+clients/round, B=20, SGD lr=0.1 — test accuracy 44.7 beyond ~4000 rounds.
+
+Runs on the real fed_cifar100 h5 archives when ``--data_dir`` has them;
+otherwise generates the offline TFF-schema fixture
+(data/tff_fixture.py::write_fed_cifar100_h5_fixture — class-blob images with
+per-client Dirichlet class skew; NOT real CIFAR-100, and REPRO.md says so)
+and ingests it through the real ``tff_h5.load_fed_cifar100`` path.
+
+Usage: python -m fedml_tpu.exp.repro_fed_cifar100 [--comm_round 4000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import time
+from pathlib import Path
+
+
+def run(args) -> dict:
+    import optax
+
+    from fedml_tpu.data import load_partition_data
+    from fedml_tpu.data.fixture_util import is_fixture
+    from fedml_tpu.data.tff_fixture import write_fed_cifar100_h5_fixture
+    from fedml_tpu.models.resnet import resnet18_gn
+    from fedml_tpu.obs.metrics import logging_config
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    logging_config(0)
+    data_dir = Path(args.data_dir)
+    real = (
+        (data_dir / "fed_cifar100_train.h5").exists()
+        and not is_fixture(data_dir, "fed_cifar100")
+    )
+    if not real:
+        logging.info("no real fed_cifar100 h5 at %s — using offline fixture", data_dir)
+        write_fed_cifar100_h5_fixture(
+            data_dir, n_train_clients=args.client_num_in_total, seed=args.seed
+        )
+    ds = load_partition_data("fed_cifar100", str(data_dir))
+
+    trainer = ClientTrainer(
+        module=resnet18_gn(class_num=ds.class_num),
+        optimizer=optax.sgd(args.lr),
+        epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=ds.train.num_clients,
+        client_num_per_round=args.client_num_per_round,
+        batch_size=args.batch_size,
+        comm_round=args.comm_round,
+        epochs=1,
+        frequency_of_the_test=args.frequency_of_the_test,
+        seed=args.seed,
+    )
+    sim = FedSim(trainer, ds.train, ds.test_arrays, cfg)
+
+    from fedml_tpu.exp._loop import run_rounds
+
+    records, wall = run_rounds(sim, cfg, args.metrics_out)
+
+    evals = [r for r in records if "Test/Acc" in r]
+    if not evals:
+        raise RuntimeError("no completed eval rounds — nothing to report")
+    best = max(e["Test/Acc"] for e in evals)
+    first_over = next((e["round"] for e in evals if e["Test/Acc"] > 0.447), None)
+    result = {
+        "dataset": "fed_cifar100 h5" if real else "TFF-schema offline fixture (class blobs)",
+        "clients": ds.train.num_clients,
+        "samples": ds.train.num_samples,
+        "rounds": len(records),
+        "best_test_acc": round(best, 4),
+        "first_round_over_44.7": first_over,
+        "rounds_per_sec": round(len(records) / wall, 2),
+        "final": {k: round(v, 4) for k, v in evals[-1].items() if k != "round"},
+    }
+    if args.out:
+        _write_report(Path(args.out), args, result, evals)
+    logging.info("fed_cifar100 repro result: %s", result)
+    return result
+
+
+def _write_report(path: Path, args, result: dict, evals: list) -> None:
+    from fedml_tpu.exp._report import update_section
+
+    step = max(1, len(evals) // 12)
+    curve = ", ".join(
+        f"{e['round']}:{e['Test/Acc'] * 100:.1f}" for e in evals[::step]
+    )
+    fixture_note = (
+        "Real fed_cifar100 h5 archives were used."
+        if result["dataset"] == "fed_cifar100 h5"
+        else (
+            "**Data note:** this environment has no network egress, so the real "
+            "fed_cifar100 h5 archives are unavailable. The run uses the "
+            "TFF-schema offline fixture "
+            "(`fedml_tpu/data/tff_fixture.py::write_fed_cifar100_h5_fixture`): "
+            "class-blob RGB images with per-client Dirichlet class skew, in the "
+            "exact `examples/<client>/image|label` h5 schema, ingested through "
+            "the real `tff_h5.load_fed_cifar100` path. Blob classes are far "
+            "easier than real CIFAR-100, so the absolute accuracy is not "
+            "comparable to the published 44.7; treat the result as evidence "
+            "that the 500-client pipeline + the row's exact "
+            "model/optimizer/cohort recipe (ResNet18-GN, 10/round, B=20, "
+            "lr 0.1) runs and converges at full scale."
+        )
+    )
+    update_section(path, "fed_cifar100_resnet18gn", f"""# BASELINE reproduction — fed_cifar100 + ResNet18-GN (shallow-NN table row)
+
+Reference target (BASELINE.md / benchmark/README.md:54-57): test acc **44.7**
+beyond **~4000 rounds** — 500 clients, 10/round, B=20, SGD lr=0.1, E=1,
+ResNet-18 with GroupNorm.
+
+{fixture_note}
+
+## Config
+
+| clients | per round | batch | lr | local epochs | rounds |
+|---|---|---|---|---|---|
+| {result['clients']} | {args.client_num_per_round} | {args.batch_size} | {args.lr} | 1 | {result['rounds']} |
+
+## Result
+
+- best test accuracy: **{result['best_test_acc'] * 100:.2f}**
+- first round with test acc > 44.7: **{result['first_round_over_44.7']}**
+- wall-clock: {result['rounds_per_sec']} rounds/sec on this chip
+- raw per-round metrics: `{args.metrics_out}`
+
+Accuracy curve (round:acc): {curve}
+
+Reproduce with: `python -m fedml_tpu.exp.repro_fed_cifar100 --out REPRO.md`
+""")
+
+
+def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument("--data_dir", type=str, default="./data/fed_cifar100")
+    parser.add_argument("--client_num_in_total", type=int, default=500)
+    parser.add_argument("--client_num_per_round", type=int, default=10)
+    parser.add_argument("--batch_size", type=int, default=20)
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--comm_round", type=int, default=4000)
+    parser.add_argument("--frequency_of_the_test", type=int, default=50)
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--metrics_out", type=str, default="repro_fed_cifar100_metrics.jsonl")
+    parser.add_argument("--out", type=str, default="REPRO.md")
+    return parser
+
+
+def main(argv=None):
+    args = add_args(argparse.ArgumentParser("fed_cifar100 baseline repro")).parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -27,7 +27,7 @@ def run(args) -> dict:
 
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data import load_partition_data
-    from fedml_tpu.data.leaf_fixture import FIXTURE_MARKER
+    from fedml_tpu.data.fixture_util import is_fixture
     from fedml_tpu.data.tff_fixture import write_femnist_h5_fixture
     from fedml_tpu.models.cnn import CNNDropOut
     from fedml_tpu.obs.metrics import logging_config
@@ -37,7 +37,7 @@ def run(args) -> dict:
     data_dir = Path(args.data_dir)
     real = (
         (data_dir / "fed_emnist_train.h5").exists()
-        and not (data_dir / FIXTURE_MARKER).exists()
+        and not is_fixture(data_dir, "femnist")
     )
     if not real:
         # idempotent: regenerates only when absent or when the marker records

@@ -26,7 +26,8 @@ def run(args) -> dict:
 
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data import load_partition_data
-    from fedml_tpu.data.leaf_fixture import FIXTURE_MARKER, write_leaf_mnist_fixture
+    from fedml_tpu.data.fixture_util import is_fixture
+    from fedml_tpu.data.leaf_fixture import write_leaf_mnist_fixture
     from fedml_tpu.models.linear import LogisticRegression
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
@@ -36,7 +37,7 @@ def run(args) -> dict:
     real = (
         (data_dir / "train").is_dir()
         and any((data_dir / "train").glob("*.json"))
-        and not (data_dir / FIXTURE_MARKER).exists()
+        and not is_fixture(data_dir, "mnist")
     )
     if not real:
         logging.info("no LEAF files at %s — generating offline fixture", data_dir)

@@ -15,6 +15,14 @@ import jax  # noqa: E402
 # start; force the test suite onto the virtual 8-device CPU mesh regardless.
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache: most of this suite's wall-clock is
+# XLA:CPU compilation of federated round programs, and many tests rebuild
+# the same program shapes. Warm runs skip those compiles entirely.
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("FEDML_TPU_JAX_CACHE",
+                                 "/tmp/fedml_tpu_jax_cache"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

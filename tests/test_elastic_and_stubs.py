@@ -159,6 +159,9 @@ def _install_fake_paho(monkeypatch, broker):
 
         def subscribe(self, topic, qos=0):
             broker.subscribe(topic, self)
+            cb = getattr(self, "on_subscribe", None)
+            if cb is not None:
+                cb(self, None, 0, (qos,))
 
         def publish(self, topic, payload, qos=0, retain=False):
             broker.publish(topic, payload)

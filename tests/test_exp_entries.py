@@ -275,7 +275,7 @@ def test_cli_hetero_fix_partition(tmp_path):
         ])
 
 
-def test_cli_mqtt_s3_offloads_model_blobs(tmp_path):
+def test_cli_mqtt_s3_offloads_model_blobs(tmp_path, monkeypatch):
     """--backend mqtt_s3 really routes model payloads through the object
     store: with a tiny threshold the FS store fills with blob files while the
     protocol still converges (reference MQTT_S3,
@@ -290,7 +290,7 @@ def test_cli_mqtt_s3_offloads_model_blobs(tmp_path):
         puts["n"] += 1
         return orig_put(self, key, data)
 
-    oslib.FileSystemStore.put = counting_put
+    monkeypatch.setattr(oslib.FileSystemStore, "put", counting_put)
     store = tmp_path / "store"
     final = main([
         "--dataset", "synthetic", "--model", "lr", "--backend", "mqtt_s3",
@@ -303,7 +303,6 @@ def test_cli_mqtt_s3_offloads_model_blobs(tmp_path):
     # cleanup=True deletes consumed blobs, so count put() calls instead of
     # files: the model payloads must actually have ridden the store
     assert puts["n"] > 0
-    oslib.FileSystemStore.put = orig_put
 
 
 def test_cli_message_passing_save_and_warm_start(tmp_path):

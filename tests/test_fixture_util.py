@@ -1,0 +1,62 @@
+"""The shared offline-fixture guard (data/fixture_util.py): dataset-keyed
+markers, real-data preservation, config-keyed regeneration — including the
+cross-dataset collision where one dataset's fixture must never invalidate
+(or delete) another dataset's REAL archives in the same directory."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.data import fixture_util
+from fedml_tpu.data.tff_fixture import (
+    write_fed_cifar100_h5_fixture,
+    write_femnist_h5_fixture,
+)
+
+h5py = pytest.importorskip("h5py")
+
+
+def test_two_datasets_share_a_directory_without_collisions(tmp_path):
+    # REAL femnist archives (no marker) + a generated fed_cifar100 fixture
+    (tmp_path / "fed_emnist_train.h5").write_bytes(b"REAL")
+    write_fed_cifar100_h5_fixture(tmp_path, n_train_clients=3, n_test_clients=1,
+                                  samples_per_client=8)
+    # the femnist writer must still see its archives as REAL and not touch them
+    write_femnist_h5_fixture(tmp_path, n_clients=4, seed=0)
+    assert (tmp_path / "fed_emnist_train.h5").read_bytes() == b"REAL"
+    # and the fed_cifar100 fixture must not regenerate on the next call
+    before = (tmp_path / "fed_cifar100_train.h5").stat().st_mtime_ns
+    write_fed_cifar100_h5_fixture(tmp_path, n_train_clients=3, n_test_clients=1,
+                                  samples_per_client=8)
+    assert (tmp_path / "fed_cifar100_train.h5").stat().st_mtime_ns == before
+
+
+def test_prepare_contract(tmp_path):
+    cfg = {"n": 3, "seed": 0}
+    # fresh dir: proceed, marker written first
+    assert fixture_util.prepare(tmp_path, "demo", cfg, ["a.bin"])
+    assert fixture_util.is_fixture(tmp_path, "demo")
+    (tmp_path / "a.bin").write_bytes(b"F1")
+    # same config: skip
+    assert not fixture_util.prepare(tmp_path, "demo", cfg, ["a.bin"])
+    # changed config: stale files deleted, proceed
+    assert fixture_util.prepare(tmp_path, "demo", {"n": 4, "seed": 0}, ["a.bin"])
+    assert not (tmp_path / "a.bin").exists()
+    (tmp_path / "a.bin").write_bytes(b"F2")
+    # another dataset's marker does not claim these files
+    assert not fixture_util.is_fixture(tmp_path / "elsewhere", "demo")
+    # real data (no marker anywhere): never proceed, never delete
+    real = tmp_path / "realdir"
+    real.mkdir()
+    (real / "a.bin").write_bytes(b"REAL")
+    assert not fixture_util.prepare(real, "demo", cfg, ["a.bin"])
+    assert (real / "a.bin").read_bytes() == b"REAL"
+
+
+def test_legacy_unkeyed_marker_reads_as_fixture(tmp_path):
+    (tmp_path / fixture_util.LEGACY_MARKER).write_text("old round-2 marker\n")
+    (tmp_path / "a.bin").write_bytes(b"OLD")
+    assert fixture_util.is_fixture(tmp_path, "anything")
+    # a config-keyed regeneration replaces the legacy marker with a keyed one
+    assert fixture_util.prepare(tmp_path, "demo", {"v": 1}, ["a.bin"])
+    assert not (tmp_path / fixture_util.LEGACY_MARKER).exists()
+    assert fixture_util.marker_path(tmp_path, "demo").exists()

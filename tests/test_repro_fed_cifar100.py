@@ -1,0 +1,81 @@
+"""The fed_cifar100 + ResNet18-GN reproduction pipeline
+(exp/repro_fed_cifar100.py): quick end-to-end at small scale through the real
+TFF h5 ingestion; the full 500-client 4000-round run is slow-marked — its
+committed artifacts live in REPRO.md / repro_fed_cifar100_metrics.jsonl."""
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from fedml_tpu.data.tff_fixture import write_fed_cifar100_h5_fixture
+
+
+def test_fixture_is_real_tff_schema(tmp_path):
+    out = write_fed_cifar100_h5_fixture(
+        tmp_path / "fc", n_train_clients=6, n_test_clients=2,
+        samples_per_client=20, seed=3,
+    )
+    with h5py.File(out / "fed_cifar100_train.h5", "r") as f:
+        cids = sorted(f["examples"].keys())
+        assert len(cids) == 6
+        g = f["examples"][cids[0]]
+        assert g["image"].shape == (20, 32, 32, 3)
+        assert g["image"].dtype == np.uint8
+        assert g["label"].dtype == np.int64
+        assert 0 <= g["label"][()].min() and g["label"][()].max() < 100
+    # idempotent on same config, regenerates on different seed
+    assert write_fed_cifar100_h5_fixture(
+        tmp_path / "fc", n_train_clients=6, n_test_clients=2,
+        samples_per_client=20, seed=3) == out
+    write_fed_cifar100_h5_fixture(
+        tmp_path / "fc", n_train_clients=3, n_test_clients=2,
+        samples_per_client=20, seed=4)
+    with h5py.File(out / "fed_cifar100_train.h5", "r") as f:
+        assert len(f["examples"].keys()) == 3
+
+
+def test_fixture_never_deletes_unmarked_archives(tmp_path):
+    d = tmp_path / "fc"
+    d.mkdir()
+    (d / "fed_cifar100_train.h5").write_bytes(b"REAL")
+    write_fed_cifar100_h5_fixture(d, n_train_clients=3, n_test_clients=1)
+    assert (d / "fed_cifar100_train.h5").read_bytes() == b"REAL"
+
+
+@pytest.mark.slow
+def test_repro_pipeline_converges_small(tmp_path):
+    """slow: ResNet18-GN steps are minutes of single-core XLA:CPU compute
+    even at toy scale; the committed REPRO.md artifacts carry the full-scale
+    TPU evidence (4000 rounds, 500 clients, 3.9 rounds/sec)."""
+    from fedml_tpu.data.tff_fixture import write_fed_cifar100_h5_fixture
+    from fedml_tpu.exp.repro_fed_cifar100 import main
+
+    write_fed_cifar100_h5_fixture(tmp_path / "fc", n_train_clients=8,
+                                  n_test_clients=2, samples_per_client=24,
+                                  seed=0)
+    result = main([
+        "--client_num_in_total", "8", "--comm_round", "10",
+        "--client_num_per_round", "4", "--batch_size", "8",
+        "--frequency_of_the_test", "5",
+        "--data_dir", str(tmp_path / "fc"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    # 10 toy rounds of a 100-class task: well above the 1% random floor is
+    # the right bar here; the full-scale convergence evidence (acc 1.0 on
+    # the fixture at 4000 rounds) is the committed REPRO.md artifact
+    assert result["best_test_acc"] > 0.05, result
+    assert (tmp_path / "R.md").exists()
+
+
+@pytest.mark.slow
+def test_repro_full_scale(tmp_path):
+    from fedml_tpu.exp.repro_fed_cifar100 import main
+
+    result = main([
+        "--data_dir", str(tmp_path / "fc"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    assert result["best_test_acc"] > 0.447, result

@@ -172,3 +172,88 @@ def test_dropped_uploader_still_reconstructs_full_aggregate():
     )
     for a, b in zip(jax.tree.leaves(full), jax.tree.leaves(dropped)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+class _DropShareComm(LoopbackCommManager):
+    """A client transport that dies BEFORE the share leg: its peer shares
+    (and everything after) never leave — the pre-share dropout case the
+    subset-consistency recovery exists for."""
+
+    def send_message(self, msg: Message) -> None:
+        if msg.get_type() in (TAMessage.MSG_TYPE_C2C_SHARE,
+                              TAMessage.MSG_TYPE_C2S_SHARE_SUM,
+                              TAMessage.MSG_TYPE_C2S_SHARE_REPORT):
+            return
+        super().send_message(msg)
+
+
+def test_pre_share_drop_recovers_via_inclusion_set():
+    """A client that never sends its peer shares must not stall the round:
+    survivors report their holders, the server broadcasts the agreed
+    inclusion set, and the reconstructed aggregate equals open FedAvg over
+    the SURVIVORS (weight-renormalized), to quantization tolerance."""
+    train, _ = gaussian_blobs(n_clients=WORKERS, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    dead = WORKERS  # last rank dies pre-share
+
+    fabric = LoopbackFabric(WORKERS + 1)
+
+    def make_comm(rank):
+        if rank == dead:
+            return _DropShareComm(fabric, rank)
+        return LoopbackCommManager(fabric, rank)
+
+    got = run_turboaggregate(
+        trainer, train, WORKERS, 1, BATCH, make_comm,
+        seed=0, round_timeout=1.5, share_timeout=0.5,
+        threshold=1,  # t+1 = 2 <= 3 survivors
+    )
+
+    # open-math oracle over the survivors only, renormalized
+    template, _, _ = __import__(
+        "fedml_tpu.algorithms.fedavg_distributed", fromlist=["init_template"]
+    ).init_template(trainer, train.arrays, BATCH, 0)
+    local_train = jax.jit(make_local_train(trainer))
+    locals_, ns = [], []
+    for rank in range(1, WORKERS + 1):
+        if rank == dead:
+            continue
+        ci = (rank - 1) % train.num_clients
+        batches, weights = stack_cohort(
+            train, np.asarray([ci]), BATCH, rng=np.random.RandomState(1000),
+        )
+        batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
+        new_vars, _ = local_train(template, batches, jax.random.key(rank * 100003))
+        locals_.append(jax.tree.map(np.asarray, new_vars))
+        ns.append(float(weights[0]))
+    w = np.asarray(ns) / sum(ns)
+    expected = jax.tree.map(
+        lambda *leaves: np.sum([wi * l for wi, l in zip(w, leaves)], axis=0),
+        *locals_,
+    )
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_pre_share_drop_recovers_without_round_timeout():
+    """share_timeout alone (round_timeout=None) must still recover: the
+    server arms a default grace timer to declare the silent rank dead, so
+    the inclusion-set broadcast cannot deadlock on a report that never
+    comes."""
+    train, _ = gaussian_blobs(n_clients=WORKERS, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    fabric = LoopbackFabric(WORKERS + 1)
+
+    def make_comm(rank):
+        if rank == WORKERS:
+            return _DropShareComm(fabric, rank)
+        return LoopbackCommManager(fabric, rank)
+
+    got = run_turboaggregate(
+        trainer, train, WORKERS, 1, BATCH, make_comm,
+        seed=0, share_timeout=0.3, threshold=1,
+    )
+    flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(got)])
+    assert np.all(np.isfinite(flat))
