@@ -130,6 +130,7 @@ class TAServerManager(ServerManager):
         self._share_sums: dict[int, tuple[tuple[int, ...], np.ndarray]] = {}
         self._reports: dict[int, tuple[int, ...]] = {}
         self._include_sent = False
+        self._include_set: list[int] = []
         self._timed_out = False
         self._timer: threading.Timer | None = None
         self._lock = threading.Lock()
@@ -215,38 +216,57 @@ class TAServerManager(ServerManager):
         with self._lock:
             if int(msg.get(TAMessage.KEY_ROUND)) != self.round_idx:
                 return
-            self._reports[msg.get_sender_id()] = tuple(
+            sender = msg.get_sender_id()
+            self._reports[sender] = tuple(
                 int(i) for i in msg.get(TAMessage.KEY_HOLDERS)
             )
-            covered = set(self._reports) | set(self._share_sums)
             if self._include_sent:
-                return
-            # decide as soon as every rank is accounted for, or — with dead
-            # clients that will never speak — when the reporters alone could
-            # reconstruct (they are the live set)
-            if len(covered) < self.worker_num and not (
-                len(self._reports) >= self.threshold + 1 and self._timed_out
-            ):
-                # arm the dead-rank-declaring timer even when the caller set
-                # no round_timeout: a pre-share drop would otherwise wait
-                # forever for the dead rank's report (the exact stall the
-                # share_timeout feature exists to prevent)
-                if self._timer is None and not self._timed_out:
-                    grace = self.round_timeout if self.round_timeout is not None else 5.0
-                    self._timer = threading.Timer(grace, self._timeout)
-                    self._timer.daemon = True
-                    self._timer.start()
-                return
-            include = sorted(set.intersection(
-                *(set(h) for h in self._reports.values())
-            ))
-            self._include_sent = True
-            reporters = sorted(self._reports)
+                # a reporter arriving after the decision still needs the set
+                # (a lost reply would strand it mid-round forever); sound as
+                # long as it holds every member, which the intersection rule
+                # cannot guarantee for late reports — verify and fall back to
+                # excluding its share-sum (it simply won't submit)
+                include = self._include_set
+                late = [sender] if set(include) <= set(self._reports[sender]) else []
+            else:
+                covered = set(self._reports) | set(self._share_sums)
+                # decide as soon as every rank is accounted for, or — with
+                # dead clients that will never speak — when the timer has
+                # declared the silent ranks dead
+                if len(covered) < self.worker_num and not (
+                    len(self._reports) >= self.threshold + 1 and self._timed_out
+                ):
+                    # arm the dead-rank-declaring timer even when the caller
+                    # set no round_timeout: a pre-share drop would otherwise
+                    # wait forever for the dead rank's report (the exact
+                    # stall the share_timeout feature exists to prevent)
+                    if self._timer is None and not self._timed_out:
+                        grace = (self.round_timeout
+                                 if self.round_timeout is not None else 5.0)
+                        self._timer = threading.Timer(grace, self._timeout)
+                        self._timer.daemon = True
+                        self._timer.start()
+                    return
+                include, late = self._decide_include_locked()
+        self._send_include(include, late)
+
+    def _decide_include_locked(self) -> tuple[list[int], list[int]]:
+        """Intersect the reports into the agreed inclusion set (caller holds
+        the lock). Returns (include, reporters to notify)."""
+        include = sorted(set.intersection(
+            *(set(h) for h in self._reports.values())
+        ))
+        self._include_sent = True
+        self._include_set = include
+        reporters = sorted(self._reports)
         logging.info(
             "turboaggregate round %d: share dropout — inclusion set %s "
             "agreed from %d reports", self.round_idx, include, len(reporters)
         )
-        for w in reporters:
+        return include, reporters
+
+    def _send_include(self, include: list[int], recipients: list[int]) -> None:
+        for w in recipients:
             m = Message(TAMessage.MSG_TYPE_S2C_INCLUDE, 0, w)
             m.add_params(TAMessage.KEY_ROUND, self.round_idx)
             m.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
@@ -259,19 +279,11 @@ class TAServerManager(ServerManager):
         # incoming share-sums then close the round normally
         with self._lock:
             if self._reports and not self._include_sent:
-                include = sorted(set.intersection(
-                    *(set(h) for h in self._reports.values())
-                ))
-                self._include_sent = True
-                reporters = sorted(self._reports)
+                include, reporters = self._decide_include_locked()
             else:
                 reporters = None
         if reporters is not None:
-            for w in reporters:
-                m = Message(TAMessage.MSG_TYPE_S2C_INCLUDE, 0, w)
-                m.add_params(TAMessage.KEY_ROUND, self.round_idx)
-                m.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
-                self.send_message(m)
+            self._send_include(include, reporters)
             return
         self._close_round()
 
@@ -306,6 +318,7 @@ class TAServerManager(ServerManager):
             self._share_sums.clear()
             self._reports.clear()
             self._include_sent = False
+            self._include_set = []
             closed_round = self.round_idx
             self.round_idx += 1
             self._timed_out = False
@@ -397,6 +410,17 @@ class TAClientManager(ClientManager):
             self.finish()
             return
         round_idx = int(msg.get(TAMessage.KEY_ROUND))
+        with self._lock:
+            # a new sync closes all earlier rounds: drop their buffered peer
+            # shares / inclusion sets / timers (a round this client never
+            # submitted — e.g. it was excluded from the inclusion set —
+            # would otherwise leak one model-sized share per peer forever)
+            for stale in [r for r in self._peer_shares if r < round_idx]:
+                del self._peer_shares[stale]
+            for stale in [r for r in self._include if r < round_idx]:
+                del self._include[stale]
+            for stale in [r for r in self._share_timers if r < round_idx]:
+                self._share_timers.pop(stale).cancel()
         self._p_i = float(msg.get(TAMessage.KEY_WEIGHT))
         flat = np.asarray(msg.get(TAMessage.KEY_MODEL))
         variables = unpack_pytree(flat, self._desc)

@@ -74,8 +74,12 @@ class MqttCommManager(BaseCommunicationManager):
             qos=1, retain=False,
         )
         self._subscribed = threading.Event()
-        self._expected_subacks = max(self.client_num, 1) if client_id == 0 else 1
+        self._expected_subacks = self.client_num if client_id == 0 else 1
         self._suback_count = 0
+        if self._expected_subacks == 0:
+            # a server with no clients yet subscribes to nothing — there is
+            # no SUBACK to wait for
+            self._subscribed.set()
         self.client.on_connect = self._on_connect
         self.client.on_subscribe = self._on_subscribe
         self.client.on_message = self._on_message

@@ -92,8 +92,10 @@ def write_femnist_h5_fixture(
                 g = grp.create_group(cid)
                 g.create_dataset("pixels", data=x[sl], compression="gzip")
                 g.create_dataset("label", data=y[sl].astype(np.int64))
-    tmp_train.rename(out / "fed_emnist_train.h5")
+    # probe file (train) LAST: a crash between renames must leave a state
+    # prepare() regenerates (probe missing), never a pinned half-fixture
     tmp_test.rename(out / "fed_emnist_test.h5")
+    tmp_train.rename(out / "fed_emnist_train.h5")
     return out
 
 
@@ -151,6 +153,7 @@ def write_fed_cifar100_h5_fixture(
             g = gte.create_group(f"c{ci:05d}")
             g.create_dataset("image", data=x, compression="gzip")
             g.create_dataset("label", data=y)
-    tmp_train.rename(out / "fed_cifar100_train.h5")
+    # probe file (train) LAST — see write_femnist_h5_fixture
     tmp_test.rename(out / "fed_cifar100_test.h5")
+    tmp_train.rename(out / "fed_cifar100_train.h5")
     return out

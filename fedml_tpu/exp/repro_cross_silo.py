@@ -68,7 +68,9 @@ def write_cifar10_fixture(out_dir: str | Path, n_train: int = 50_000,
         with open(tmp, "wb") as fh:
             pickle.dump({b"data": rows, b"labels": y.tolist()}, fh)
         tmp_final.append((tmp, out / name))
-    for tmp, final in tmp_final:
+    # probe file (data_batch_1) LAST: a crash between renames leaves the
+    # probe missing, so prepare() regenerates instead of pinning a half-set
+    for tmp, final in sorted(tmp_final, key=lambda tf: tf[1].name == "data_batch_1"):
         tmp.rename(final)
     return out
 

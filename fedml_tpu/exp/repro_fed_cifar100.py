@@ -43,7 +43,9 @@ def run(args) -> dict:
     if not real:
         logging.info("no real fed_cifar100 h5 at %s — using offline fixture", data_dir)
         write_fed_cifar100_h5_fixture(
-            data_dir, n_train_clients=args.client_num_in_total, seed=args.seed
+            data_dir, n_train_clients=args.client_num_in_total,
+            n_test_clients=args.n_test_clients,
+            samples_per_client=args.samples_per_client, seed=args.seed,
         )
     ds = load_partition_data("fed_cifar100", str(data_dir))
 
@@ -143,6 +145,10 @@ Reproduce with: `python -m fedml_tpu.exp.repro_fed_cifar100 --out REPRO.md`
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--data_dir", type=str, default="./data/fed_cifar100")
     parser.add_argument("--client_num_in_total", type=int, default=500)
+    parser.add_argument("--n_test_clients", type=int, default=100,
+                        help="fixture-only: test clients to generate")
+    parser.add_argument("--samples_per_client", type=int, default=100,
+                        help="fixture-only: samples per generated client")
     parser.add_argument("--client_num_per_round", type=int, default=10)
     parser.add_argument("--batch_size", type=int, default=20)
     parser.add_argument("--lr", type=float, default=0.1)

@@ -25,6 +25,7 @@ def test_main_vfl_smoke():
     assert out["Test/Acc"] > 0.6
 
 
+@pytest.mark.slow  # compile/compute-heavy on the single-core CI box; core logic covered by faster siblings
 def test_main_fedgkt_smoke():
     from fedml_tpu.exp.main_fedgkt import main
 
@@ -42,6 +43,7 @@ def test_main_fednas_smoke():
     assert "genotype_normal" in out
 
 
+@pytest.mark.slow  # compile/compute-heavy on the single-core CI box; core logic covered by faster siblings
 def test_main_fednas_gdas_mode():
     from fedml_tpu.exp.main_fednas import main
 
@@ -50,6 +52,7 @@ def test_main_fednas_gdas_mode():
     assert np.isfinite(out["Train/Loss"])
 
 
+@pytest.mark.slow  # compile/compute-heavy on the single-core CI box; core logic covered by faster siblings
 def test_main_fedseg_smoke():
     from fedml_tpu.exp.main_fedseg import main
 
@@ -69,6 +72,7 @@ def test_main_turboaggregate_smoke():
     assert 0.0 <= out["test_acc"] <= 1.0
 
 
+@pytest.mark.slow  # compile/compute-heavy on the single-core CI box; core logic covered by faster siblings
 def test_main_fedgan_smoke(tmp_path):
     from fedml_tpu.exp.main_fedavg import main
 

@@ -1,6 +1,7 @@
 """FedNAS / DARTS tests."""
 
 import jax
+import pytest
 import jax.numpy as jnp
 import numpy as np
 import optax
@@ -85,6 +86,7 @@ def test_decode_genotype_infers_steps():
     assert len(g.normal) == 8 and len(g.reduce) == 8
 
 
+@pytest.mark.slow  # compile-heavy on XLA:CPU; kept out of the fast gate
 def test_unrolled_arch_grad_differs_and_matches_fd_oracle():
     """Second-order architect (architect.py:169-197): the unrolled α-gradient
     must differ from first-order, and its exact jvp Hessian-vector term must
@@ -172,6 +174,7 @@ def test_unrolled_arch_grad_differs_and_matches_fd_oracle():
         jax.config.update("jax_enable_x64", False)
 
 
+@pytest.mark.slow  # compile-heavy on XLA:CPU; kept out of the fast gate
 def test_unrolled_local_search_end_to_end():
     """unrolled=True drives the full scan path (jit-compatible)."""
     net = DARTSNetwork(num_classes=4, channels=4, layers=2, steps=2)

@@ -65,6 +65,7 @@ def test_ring_attention_exact(rng, causal):
     np.testing.assert_allclose(out, ref, atol=1e-5)
 
 
+@pytest.mark.slow  # compile-heavy on XLA:CPU; kept out of the fast gate
 def test_sp_train_step_matches_single_device(rng):
     vocab, b, t = 31, 2, 64
     mesh = seqlib.sequence_mesh(8)
@@ -170,6 +171,7 @@ def test_flash_bwd_fully_masked_rows(rng):
         np.testing.assert_allclose(a, b_, atol=1e-4)
 
 
+@pytest.mark.slow  # compile-heavy on XLA:CPU; kept out of the fast gate
 def test_transformer_remat_matches_plain():
     """jax.checkpoint on blocks must not change values or gradients."""
     import numpy as np
