@@ -21,3 +21,11 @@ def update_section(path: str | Path, name: str, content: str) -> None:
     else:
         text = (text.rstrip() + "\n\n" if text.strip() else "") + block
     p.write_text(text)
+
+
+def acc_curve(evals: list, points: int = 12, key: str = "Test/Acc") -> str:
+    """Downsampled ``round:acc%`` curve string for REPRO.md sections."""
+    step = max(1, len(evals) // points)
+    return ", ".join(
+        f"{e['round']}:{e[key] * 100:.1f}" for e in evals[::step]
+    )

@@ -174,12 +174,9 @@ def run(args) -> dict:
 
 
 def _write_report(path: Path, args, result: dict, evals: list, real: bool) -> None:
-    from fedml_tpu.exp._report import update_section
+    from fedml_tpu.exp._report import acc_curve, update_section
 
-    step = max(1, len(evals) // 14)
-    curve = ", ".join(
-        f"{e['round']}:{e['Test/Acc'] * 100:.1f}" for e in evals[::step]
-    )
+    curve = acc_curve(evals, points=14)
     target = "93.19 (IID)" if args.partition_method == "homo" else "87.12 (LDA α=0.5)"
     data_note = (
         "Real CIFAR-10 pickle batches were used."

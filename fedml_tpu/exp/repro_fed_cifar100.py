@@ -91,12 +91,9 @@ def run(args) -> dict:
 
 
 def _write_report(path: Path, args, result: dict, evals: list) -> None:
-    from fedml_tpu.exp._report import update_section
+    from fedml_tpu.exp._report import acc_curve, update_section
 
-    step = max(1, len(evals) // 12)
-    curve = ", ".join(
-        f"{e['round']}:{e['Test/Acc'] * 100:.1f}" for e in evals[::step]
-    )
+    curve = acc_curve(evals, points=12)
     fixture_note = (
         "Real fed_cifar100 h5 archives were used."
         if result["dataset"] == "fed_cifar100 h5"

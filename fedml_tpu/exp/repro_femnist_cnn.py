@@ -105,13 +105,9 @@ def run(args) -> dict:
 
 
 def _write_report(path: Path, args, result: dict, evals: list) -> None:
-    from fedml_tpu.exp._report import update_section
+    from fedml_tpu.exp._report import acc_curve, update_section
 
-    step = max(1, len(evals) // 12)
-    curve = ", ".join(
-        f"{e['round']}:{e['Test/Acc'] * 100:.1f}"
-        for e in evals[::step]
-    )
+    curve = acc_curve(evals, points=12)
     fixture_note = (
         "Real FederatedEMNIST h5 archives were used."
         if result["dataset"] == "FederatedEMNIST h5"
