@@ -1,10 +1,13 @@
-"""BASELINE reproduction: the cross-silo flagship table (CIFAR-10 + ResNet-56).
+"""BASELINE reproduction: the cross-silo flagship table.
 
 Reference recipe (benchmark/README.md:102-110; BASELINE.md cross-silo table):
-10 silo-clients, B=64, SGD lr .001 wd .001, E=20 local epochs, 100 rounds —
-test acc 93.19 (IID) / 87.12 (non-IID LDA α=0.5) on a GPU cluster. This is
-the one config exercising the clients×silo 2-D mesh, bf16 compute, and
-on-device augmentation (crop/flip/cutout) together.
+10 silo-clients, B=64, SGD lr .001 wd .001, E=20 local epochs, 100 rounds,
+for all six dataset×model combos — {cifar10, cifar100, cinic10} ×
+{resnet56, mobilenet} (published: 93.19/87.12, 68.91/64.70, 82.57/73.49,
+91.12/86.32, 55.12/53.54, 79.95/71.23 IID/non-IID) — selected here via
+``--dataset`` / ``--model``. This is the config family exercising the
+clients×silo 2-D mesh, bf16 compute, and on-device augmentation
+(crop/flip/cutout) together.
 
 Data: real CIFAR-10 pickle batches when ``--data_dir`` holds them; otherwise
 a 50k/10k offline fixture written in the exact CIFAR batch format (pickled
@@ -75,6 +78,92 @@ def write_cifar10_fixture(out_dir: str | Path, n_train: int = 50_000,
     return out
 
 
+def write_cifar100_fixture(out_dir: str | Path, n_train: int = 50_000,
+                           n_test: int = 10_000, seed: int = 0) -> Path:
+    """100-class-blob images in the real CIFAR-100 python format
+    (``cifar-100-python/{train,test}`` pickles with ``fine_labels``)."""
+    sub = "cifar-100-python"
+    out = Path(out_dir) / sub
+    if not fixture_util.prepare(
+        out_dir, "cifar100",
+        {"n_train": n_train, "n_test": n_test, "seed": seed},
+        [f"{sub}/train", f"{sub}/test"],
+    ):
+        return out
+    out.mkdir(parents=True, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(100, 32, 32, 3).astype(np.float32)
+    tmp_final = []
+    for name, n in (("test", n_test), ("train", n_train)):
+        y = rng.randint(0, 100, n).astype(np.int64)
+        x = np.clip(centers[y] + rng.normal(0, 0.25, (n, 32, 32, 3)), 0, 1)
+        rows = (x * 255).astype(np.uint8).transpose(0, 3, 1, 2).reshape(n, 3072)
+        tmp = out / (name + ".tmp")
+        with open(tmp, "wb") as fh:
+            pickle.dump({b"data": rows, b"fine_labels": y.tolist()}, fh)
+        tmp_final.append((tmp, out / name))
+    # probe file (train) LAST
+    for tmp, final in sorted(tmp_final, key=lambda tf: tf[1].name == "train"):
+        tmp.rename(final)
+    return out
+
+
+def write_cinic10_fixture(out_dir: str | Path, n_train_per_class: int = 2_000,
+                          n_valid_per_class: int = 500,
+                          n_test_per_class: int = 500, seed: int = 0) -> Path:
+    """Class-blob 32x32 PNGs in the real CINIC-10 ImageFolder layout
+    (``train/valid/test`` x 10 class dirs).
+
+    Scale is the caller's: the CLI default (``--fixture_train_n 50000``)
+    writes 5 000 train + 2x1 000 valid/test PNGs per class — 70k files,
+    minutes of one-at-a-time PIL IO, still a quarter of the real 270k;
+    REPRO.md states the per-client sample count the run actually used.
+    On a config change the split directories are cleared wholesale (the
+    marker guard only tracks the probe file; globbed PNG trees must not mix
+    generations)."""
+    import shutil
+
+    from PIL import Image
+
+    classes = ["airplane", "automobile", "bird", "cat", "deer",
+               "dog", "frog", "horse", "ship", "truck"]
+    probe = f"train/{classes[0]}/fx00000.png"
+    if not fixture_util.prepare(
+        out_dir, "cinic10",
+        {"n_train_per_class": n_train_per_class,
+         "n_valid_per_class": n_valid_per_class,
+         "n_test_per_class": n_test_per_class, "seed": seed},
+        [probe],
+    ):
+        return Path(out_dir)
+    for split in ("train", "valid", "test"):
+        shutil.rmtree(Path(out_dir) / split, ignore_errors=True)
+    rng = np.random.RandomState(seed)
+    centers = rng.rand(10, 32, 32, 3).astype(np.float32)
+    out = Path(out_dir)
+    for split, n_per in (("valid", n_valid_per_class), ("test", n_test_per_class),
+                         ("train", n_train_per_class)):
+        # the probe file (train/airplane/fx00000.png) must land LAST so a
+        # crash mid-generation leaves the probe missing and prepare()
+        # regenerates: train is the last split, airplane its last class,
+        # fx00000 its last file
+        order = classes[1:] + classes[:1] if split == "train" else classes
+        for cname in order:
+            label = classes.index(cname)
+            d = out / split / cname
+            d.mkdir(parents=True, exist_ok=True)
+            x = np.clip(
+                centers[label] + rng.normal(0, 0.25, (n_per, 32, 32, 3)), 0, 1
+            )
+            arr = (x * 255).astype(np.uint8)
+            idxs = range(n_per)
+            if split == "train" and cname == classes[0]:
+                idxs = reversed(range(n_per))
+            for i in idxs:
+                Image.fromarray(arr[i]).save(d / f"fx{i:05d}.png")
+    return out
+
+
 def run(args) -> dict:
     import jax
     import jax.numpy as jnp
@@ -83,6 +172,7 @@ def run(args) -> dict:
 
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data.cv import load_cifar
+    from fedml_tpu.models.mobilenet import MobileNet
     from fedml_tpu.models.resnet import resnet56
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.ops.augment import ImageAugment, with_augmentation
@@ -90,27 +180,50 @@ def run(args) -> dict:
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
-    data_dir = Path(args.data_dir)
-    # real = batches exist in either layout the pickle reader accepts
-    # (<dir>/cifar-10-batches-py/ or directly in <dir>) and no fixture
-    # marker claims them — existence only, the actual load happens once below
+    data_dir = Path(args.data_dir) if args.data_dir else Path(f"./data/{args.dataset}")
+    # real = data exists in a layout the reader accepts and no fixture
+    # marker claims it — existence only, the actual load happens once below
+    probes = {
+        "cifar10": [data_dir / "cifar-10-batches-py" / "data_batch_1",
+                    data_dir / "data_batch_1"],
+        "cifar100": [data_dir / "cifar-100-python" / "train",
+                     data_dir / "train"],
+        "cinic10": [data_dir / "train" / "airplane",
+                    data_dir / "CINIC-10" / "train" / "airplane",
+                    data_dir / "cinic-10" / "train" / "airplane"],
+    }[args.dataset]
     real = (
-        ((data_dir / "cifar-10-batches-py" / "data_batch_1").exists()
-         or (data_dir / "data_batch_1").exists())
-        and not fixture_util.is_fixture(data_dir, "cifar10")
+        any(p.exists() for p in probes)
+        and not fixture_util.is_fixture(data_dir, args.dataset)
     )
     if not real:
-        logging.info("no real CIFAR-10 under %s — using offline fixture", data_dir)
-        write_cifar10_fixture(data_dir, seed=args.seed)
+        logging.info("no real %s under %s — using offline fixture",
+                     args.dataset, data_dir)
+        if args.dataset == "cinic10":
+            write_cinic10_fixture(
+                data_dir, n_train_per_class=args.fixture_train_n // 10,
+                n_valid_per_class=args.fixture_test_n // 10,
+                n_test_per_class=args.fixture_test_n // 10, seed=args.seed,
+            )
+        else:
+            {"cifar10": write_cifar10_fixture,
+             "cifar100": write_cifar100_fixture}[args.dataset](
+                data_dir, n_train=args.fixture_train_n,
+                n_test=args.fixture_test_n, seed=args.seed,
+            )
 
     train, test, class_num = load_cifar(
-        "cifar10", data_dir, args.partition_method, args.partition_alpha,
+        args.dataset, data_dir, args.partition_method, args.partition_alpha,
         args.client_num_in_total, args.seed, allow_synthetic=False,
     )
 
     # the flagship numerics: bf16 compute, f32 params, wd via decoupled decay
+    model = {
+        "resnet56": lambda: resnet56(class_num=class_num, dtype=jnp.bfloat16),
+        "mobilenet": lambda: MobileNet(num_classes=class_num, dtype=jnp.bfloat16),
+    }[args.model]()
     trainer = ClientTrainer(
-        module=resnet56(class_num=class_num, dtype=jnp.bfloat16),
+        module=model,
         optimizer=optax.chain(
             optax.add_decayed_weights(args.wd), optax.sgd(args.lr)
         ),
@@ -152,7 +265,10 @@ def run(args) -> dict:
         raise RuntimeError("no completed eval rounds — nothing to report")
     best = max(e["Test/Acc"] for e in evals)
     result = {
-        "dataset": "real CIFAR-10" if real else "offline CIFAR-format fixture",
+        "dataset": (f"real {args.dataset}" if real
+                    else f"offline {args.dataset}-format fixture"),
+        "model": args.model,
+        "samples_per_client": train.num_samples // max(train.num_clients, 1),
         "partition": f"{args.partition_method}"
                      + (f"(alpha={args.partition_alpha})"
                         if args.partition_method == "hetero" else ""),
@@ -173,27 +289,43 @@ def run(args) -> dict:
     return result
 
 
+# published cross-silo table (benchmark/README.md:102-110): (IID, non-IID)
+_TARGETS = {
+    ("cifar10", "resnet56"): (93.19, 87.12),
+    ("cifar100", "resnet56"): (68.91, 64.70),
+    ("cinic10", "resnet56"): (82.57, 73.49),
+    ("cifar10", "mobilenet"): (91.12, 86.32),
+    ("cifar100", "mobilenet"): (55.12, 53.54),
+    ("cinic10", "mobilenet"): (79.95, 71.23),
+}
+
+
 def _write_report(path: Path, args, result: dict, evals: list, real: bool) -> None:
     from fedml_tpu.exp._report import acc_curve, update_section
 
     curve = acc_curve(evals, points=14)
-    target = "93.19 (IID)" if args.partition_method == "homo" else "87.12 (LDA α=0.5)"
+    iid, noniid = _TARGETS[(args.dataset, args.model)]
+    target = (f"{iid} (IID)" if args.partition_method == "homo"
+              else f"{noniid} (LDA α=0.5)")
     data_note = (
-        "Real CIFAR-10 pickle batches were used."
+        f"Real {args.dataset} data was used."
         if real else (
-            "**Data note:** this environment has no network egress, so the run "
-            "uses a 50k/10k class-blob fixture written in the exact CIFAR-10 "
-            "batch format and ingested through the real pickle reader "
-            "(`data/cv.py`). Recipe semantics (5 000 samples/client, 78 steps "
-            "× 20 local epochs per round, bf16 + crop/flip/cutout "
-            "augmentation, 2-D clients×silo mesh) are the real ones; the "
-            "absolute accuracy is NOT comparable to the published table — "
-            "treat this as the flagship recipe running end-to-end at full "
-            "scale with honest wall-clock, not as an accuracy reproduction."
+            f"**Data note:** this environment has no network egress, so the "
+            f"run uses a class-blob fixture written in the exact {args.dataset} "
+            f"on-disk format and ingested through the real reader "
+            f"(`data/cv.py`) — {result['samples_per_client']} samples/client. "
+            "Recipe semantics (B=64 x 20 local epochs per round, bf16 + "
+            "crop/flip/cutout augmentation, 2-D clients×silo mesh) are the "
+            "real ones; the absolute accuracy is NOT comparable to the "
+            "published table — treat this as the flagship recipe running "
+            "end-to-end at full scale with honest wall-clock, not as an "
+            "accuracy reproduction."
         )
     )
-    section = "cross_silo_" + args.partition_method
-    update_section(path, section, f"""# BASELINE reproduction — cross-silo flagship (CIFAR-10 + ResNet-56, {args.partition_method})
+    section = ("cross_silo_" + args.partition_method
+               if (args.dataset, args.model) == ("cifar10", "resnet56")
+               else f"cross_silo_{args.dataset}_{args.model}_{args.partition_method}")
+    update_section(path, section, f"""# BASELINE reproduction — cross-silo flagship ({args.dataset} + {args.model}, {args.partition_method})
 
 Reference target (BASELINE.md / benchmark/README.md:102-110): test acc
 **{target}** at 100 rounds — 10 clients, B=64, SGD lr .001 wd .001, E=20.
@@ -206,6 +338,8 @@ Reference target (BASELINE.md / benchmark/README.md:102-110): test acc
 |---|---|---|---|---|---|---|---|
 | {result['clients']} | {result['batch_size']} | {args.lr} | {args.wd} | {result['local_epochs']} | {result['rounds']} | {result['partition']} | {result['mesh']} |
 
+Model: **{args.model}**; {result['samples_per_client']} samples/client.
+
 ## Result
 
 - best test accuracy: **{result['best_test_acc'] * 100:.2f}**
@@ -215,12 +349,22 @@ Reference target (BASELINE.md / benchmark/README.md:102-110): test acc
 
 Accuracy curve (round:acc): {curve}
 
-Reproduce with: `python -m fedml_tpu.exp.repro_cross_silo --partition_method {args.partition_method} --out REPRO.md`
+Reproduce with: `python -m fedml_tpu.exp.repro_cross_silo --dataset {args.dataset} --model {args.model} --partition_method {args.partition_method} --out REPRO.md`
 """)
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
-    parser.add_argument("--data_dir", type=str, default="./data/cifar10")
+    parser.add_argument("--dataset", type=str, default="cifar10",
+                        choices=["cifar10", "cifar100", "cinic10"])
+    parser.add_argument("--model", type=str, default="resnet56",
+                        choices=["resnet56", "mobilenet"])
+    parser.add_argument("--data_dir", type=str, default=None,
+                        help="default: ./data/<dataset>")
+    parser.add_argument("--fixture_train_n", type=int, default=50_000,
+                        help="fixture-only: train samples to generate "
+                             "(cinic10: split across classes, valid extra)")
+    parser.add_argument("--fixture_test_n", type=int, default=10_000,
+                        help="fixture-only: test samples to generate")
     parser.add_argument("--partition_method", type=str, default="hetero",
                         choices=["hetero", "homo"])
     parser.add_argument("--partition_alpha", type=float, default=0.5)
