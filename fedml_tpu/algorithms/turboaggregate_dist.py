@@ -28,13 +28,25 @@ plaintext models. Here the secure path actually runs:
    BEFORE sending its peer shares would leave everyone waiting, so clients
    whose share wait times out report (clear metadata only) which peers'
    shares they hold; the server intersects the reports into an agreed
-   inclusion set and broadcasts it; clients then submit share-sums over
-   exactly that subset. Share-sums carry their inclusion set and the server
-   reconstructs only within the largest same-set bucket — sums over
-   different subsets are shares of different polynomials and are never
-   mixed — then renormalizes by the included weight mass. This is subset
-   consistency, not SecAgg mask recovery: simpler, and sufficient because
-   BGW shares (unlike pairwise masks) need no per-dropout unmasking.
+   inclusion set and broadcasts it to EVERY live worker — reporters AND
+   clients that already submitted full-set share-sums. Reporters submit
+   share-sums over exactly the agreed subset; a full-set holder (which
+   necessarily holds every share of any agreed subset) RESUBMITS over the
+   agreed subset, superseding its earlier full-set sum, so all live
+   workers land in one same-set bucket and t+1 is reachable even when the
+   dying client delivered shares to some-but-not-all peers. Share-sums
+   carry their inclusion set and the server reconstructs only within the
+   largest same-set bucket — sums over different subsets are shares of
+   different polynomials and are never mixed — then renormalizes by the
+   included weight mass. Two guards bound what recovery can reveal: a
+   bucket that can already reconstruct (>= t+1 full-set sums) closes the
+   round directly instead of starting subset recovery — otherwise the
+   server could interpolate BOTH polynomials and their difference is the
+   dead client's individual update — and an inclusion set smaller than
+   t+1 (disjoint reports) is refused and the round skipped. This is
+   subset consistency, not SecAgg mask recovery: simpler, and sufficient
+   because BGW shares (unlike pairwise masks) need no per-dropout
+   unmasking.
 
 Privacy: the server sees only the aggregate; a coalition of <= threshold
 clients learns nothing about another client's update (Shamir). Exactness:
@@ -220,14 +232,35 @@ class TAServerManager(ServerManager):
             self._reports[sender] = tuple(
                 int(i) for i in msg.get(TAMessage.KEY_HOLDERS)
             )
+            # capture the round INSIDE the lock: _close_round can advance
+            # round_idx between lock release and the include send, and an
+            # include stamped with the wrong round would make next round's
+            # full-set holders submit over a stale subset, silently dropping
+            # a live client's update
+            rnd = self.round_idx
             if self._include_sent:
                 # a reporter arriving after the decision still needs the set
                 # (a lost reply would strand it mid-round forever); sound as
                 # long as it holds every member, which the intersection rule
                 # cannot guarantee for late reports — verify and fall back to
                 # excluding its share-sum (it simply won't submit)
-                include = self._include_set
-                late = [sender] if set(include) <= set(self._reports[sender]) else []
+                action, include, recipients = (
+                    "send", self._include_set,
+                    [sender] if set(self._include_set)
+                    <= set(self._reports[sender]) else [],
+                )
+            elif self._bucket_max_locked() >= self.threshold + 1:
+                # PRIVACY GUARD: a reconstructable bucket already exists, so
+                # close on it instead of starting subset recovery. The
+                # full-set sums carry the dead client's delivered shares, so
+                # nothing is lost — and crucially this keeps subset recovery
+                # confined to the regime where full-set submissions <= t:
+                # were both a reconstructable full-set bucket AND a t+1
+                # subset bucket ever visible, the server could interpolate
+                # both polynomials and their difference is the dead client's
+                # individual (weighted) update — exactly the leak the
+                # protocol exists to prevent.
+                action, include, recipients = "close", None, []
             else:
                 covered = set(self._reports) | set(self._share_sums)
                 # decide as soon as every rank is accounted for, or — with
@@ -247,28 +280,104 @@ class TAServerManager(ServerManager):
                         self._timer.daemon = True
                         self._timer.start()
                     return
-                include, late = self._decide_include_locked()
-        self._send_include(include, late)
+                action, include, recipients = self._decide_include_locked()
+        self._dispatch_recovery(action, include, recipients, rnd)
 
-    def _decide_include_locked(self) -> tuple[list[int], list[int]]:
+    def _dispatch_recovery(self, action: str, include, recipients,
+                           rnd: int) -> None:
+        """Execute a recovery decision outside the lock."""
+        if action == "close":
+            self._close_round()
+        elif action == "abort":
+            self._abort_round(rnd)
+        else:
+            self._send_include(include, recipients, rnd)
+
+    def _bucket_max_locked(self) -> int:
+        """Size of the largest same-inclusion-set bucket (caller holds the
+        lock)."""
+        counts: dict[tuple[int, ...], int] = {}
+        for include, _ in self._share_sums.values():
+            counts[include] = counts.get(include, 0) + 1
+        return max(counts.values(), default=0)
+
+    def _decide_include_locked(self):
         """Intersect the reports into the agreed inclusion set (caller holds
-        the lock). Returns (include, reporters to notify)."""
+        the lock). Returns an explicit ``(action, include, recipients)``
+        triple: ``("send", set, live workers)`` normally, ``("abort", ...)``
+        when the set is refused (smaller than t+1)."""
         include = sorted(set.intersection(
             *(set(h) for h in self._reports.values())
         ))
+        if len(include) < self.threshold + 1:
+            # disjoint reports can intersect to (near-)nothing; an aggregate
+            # over < t+1 clients would reveal near-individual updates to the
+            # server — and an empty set would np.stack([]) on the client.
+            # Refuse and skip the round instead of broadcasting it (workers
+            # learn of the skip via the next sync, so no recipients here).
+            logging.error(
+                "turboaggregate round %d: agreed inclusion set %s smaller "
+                "than t+1=%d — refusing; round skipped (global unchanged)",
+                self.round_idx, include, self.threshold + 1,
+            )
+            return "abort", None, []
+        # every live worker gets the set: reporters submit over it, and
+        # full-set submitters (who hold every share of any subset) RESUBMIT
+        # over it so one same-set bucket can reach t+1 even when the dead
+        # client's shares reached only some peers. Safe against the
+        # full-minus-subset difference attack because this path only runs
+        # when no bucket reached t+1 (see the privacy guard above): the
+        # at-most-t full-set points expose the dead client's degree-t
+        # sharing polynomial at at most t points — information-theoretically
+        # nothing about its constant term (the update).
+        recipients = sorted(set(self._reports) | set(self._share_sums))
         self._include_sent = True
         self._include_set = include
-        reporters = sorted(self._reports)
         logging.info(
             "turboaggregate round %d: share dropout — inclusion set %s "
-            "agreed from %d reports", self.round_idx, include, len(reporters)
+            "agreed from %d reports; notifying %d live workers",
+            self.round_idx, include, len(self._reports), len(recipients),
         )
-        return include, reporters
+        return "send", include, recipients
 
-    def _send_include(self, include: list[int], recipients: list[int]) -> None:
+    def _abort_round(self, round_to_abort: int) -> None:
+        """Skip round ``round_to_abort`` (unreconstructable inclusion set):
+        clear state, advance the round counter, and sync clients on the
+        UNCHANGED global model so the protocol continues. Idempotent — the
+        timer thread and the receive thread can both reach the refusal
+        decision for the same round; only the first abort acts."""
+        with self._lock:
+            if self.round_idx != round_to_abort:
+                return  # already aborted/closed by the racing thread
+            self._share_sums.clear()
+            self._reports.clear()
+            self._include_sent = False
+            self._include_set = []
+            self._timed_out = False
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None
+            skipped = self.round_idx
+            self.round_idx += 1
+        # the round completed (as a no-op): report the unchanged global so
+        # curve recorders and the run harness see every round
+        self._finalize_round(skipped)
+
+    def _finalize_round(self, closed_round: int) -> None:
+        """Shared end-of-round tail for close and abort: report the round,
+        sync clients on the (possibly updated) global, finish when done."""
+        if self.on_round_done:
+            self.on_round_done(closed_round, self.global_flat)
+        finished = self.round_idx >= self.round_num
+        self._send_sync(finished)
+        if finished:
+            self.finish()
+
+    def _send_include(self, include: list[int], recipients: list[int],
+                      round_idx: int) -> None:
         for w in recipients:
             m = Message(TAMessage.MSG_TYPE_S2C_INCLUDE, 0, w)
-            m.add_params(TAMessage.KEY_ROUND, self.round_idx)
+            m.add_params(TAMessage.KEY_ROUND, round_idx)
             m.add_params(TAMessage.KEY_INCLUDE, np.asarray(include, np.int64))
             self.send_message(m)
 
@@ -276,16 +385,17 @@ class TAServerManager(ServerManager):
         self._timed_out = True
         # if clients reported a share dropout, the timer's job is to declare
         # the silent ranks dead and broadcast the inclusion set — the
-        # incoming share-sums then close the round normally
+        # incoming (re)submissions then close the round normally. A bucket
+        # that can already reconstruct takes precedence over subset recovery
+        # (privacy guard, see _on_share_report).
         with self._lock:
-            if self._reports and not self._include_sent:
-                include, reporters = self._decide_include_locked()
+            rnd = self.round_idx
+            if (self._reports and not self._include_sent
+                    and self._bucket_max_locked() < self.threshold + 1):
+                action, include, recipients = self._decide_include_locked()
             else:
-                reporters = None
-        if reporters is not None:
-            self._send_include(include, reporters)
-            return
-        self._close_round()
+                action, include, recipients = "close", None, []
+        self._dispatch_recovery(action, include, recipients, rnd)
 
     def _close_round(self) -> None:
         with self._lock:
@@ -341,12 +451,7 @@ class TAServerManager(ServerManager):
             self.global_flat.view(np.float32).astype(np.float64) + mean_delta
         ).astype(np.float32)
         self.global_flat = new_flat.view(np.uint8)
-        if self.on_round_done:
-            self.on_round_done(closed_round, self.global_flat)
-        finished = self.round_idx >= self.round_num
-        self._send_sync(finished)
-        if finished:
-            self.finish()
+        self._finalize_round(closed_round)
 
 
 class TAClientManager(ClientManager):
@@ -378,7 +483,9 @@ class TAClientManager(ClientManager):
         # shares can arrive before this client finishes its own training —
         # buffer per round
         self._peer_shares: dict[int, dict[int, np.ndarray]] = {}
-        self._submitted: set[int] = set()
+        # round -> inclusion set submitted (dict, not set: a resubmission is
+        # warranted only when the agreed set differs from what went out)
+        self._submitted: dict[int, tuple[int, ...]] = {}
         self._p_i: float | None = None
         # pre-share dropout recovery: if a peer's share hasn't arrived
         # share_timeout seconds after our own shares went out, report the
@@ -419,6 +526,8 @@ class TAClientManager(ClientManager):
                 del self._peer_shares[stale]
             for stale in [r for r in self._include if r < round_idx]:
                 del self._include[stale]
+            for stale in [r for r in self._submitted if r < round_idx]:
+                del self._submitted[stale]
             for stale in [r for r in self._share_timers if r < round_idx]:
                 self._share_timers.pop(stale).cancel()
         self._p_i = float(msg.get(TAMessage.KEY_WEIGHT))
@@ -499,20 +608,40 @@ class TAClientManager(ClientManager):
     def _maybe_submit(self, round_idx: int) -> None:
         with self._lock:
             got = self._peer_shares.get(round_idx, {})
-            if round_idx in self._submitted:
-                return
-            include = tuple(range(1, self.worker_num + 1))
-            if len(got) < self.worker_num:
+            agreed = self._include.get(round_idx)
+            prev = self._submitted.get(round_idx)
+            if prev is not None:
+                # already submitted: only a server-agreed subset DIFFERENT
+                # from what we sent warrants a RESUBMISSION. A full-set
+                # holder necessarily holds every share of any agreed subset;
+                # its subset sum supersedes the full-set one on the server,
+                # putting all live workers in one reconstructable bucket
+                # (pre-share dropout recovery, class docstring step 5).
+                if (agreed is None or tuple(agreed) == prev
+                        or not set(agreed) <= set(got)):
+                    return
+                include = tuple(agreed)
+            elif len(got) >= self.worker_num:
+                # full set — but an already-agreed subset takes precedence
+                # so the server's same-set bucket forms without a resubmit
+                include = tuple(range(1, self.worker_num + 1))
+                if agreed is not None and set(agreed) <= set(got):
+                    include = tuple(agreed)
+            else:
                 # partial shares: only submit once the server has fixed the
                 # inclusion set and we hold every share in it
-                agreed = self._include.get(round_idx)
                 if agreed is None or not set(agreed) <= set(got):
                     return
-                include = agreed
-            self._submitted.add(round_idx)
+                include = tuple(agreed)
+            if not include:
+                # the server refuses to broadcast an empty set; guard anyway
+                # so a malformed message can't np.stack([]) and kill the
+                # receive thread
+                return
+            self._submitted[round_idx] = include
             stack = np.stack([got[s] for s in include])
-            del self._peer_shares[round_idx]
-            self._include.pop(round_idx, None)
+            # keep _peer_shares/_include until the next sync's stale-round
+            # sweep: a later inclusion-set broadcast may require resubmitting
             timer = self._share_timers.pop(round_idx, None)
         if timer is not None:
             timer.cancel()

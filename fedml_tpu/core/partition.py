@@ -162,7 +162,13 @@ def write_net_dataidx_map(path, net_dataidx_map: dict[int, np.ndarray]) -> None:
     lines = ["{"]
     for client in sorted(net_dataidx_map):
         lines.append(f"{int(client)}: [")
-        lines.append(", ".join(str(int(i)) for i in net_dataidx_map[client]))
+        idxs = net_dataidx_map[client]
+        if len(idxs):
+            lines.append(", ".join(str(int(i)) for i in idxs))
+        # zero-index clients get NO indices line: the reference reader
+        # (cifar10/data_loader.py:38-42) int()s every token of every
+        # non-structural line, so an empty line would crash it; both readers
+        # parse "N: [" directly followed by "]" as an empty client
         lines.append("]")
     lines.append("}")
     Path(path).write_text("\n".join(lines) + "\n")

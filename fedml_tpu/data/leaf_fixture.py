@@ -115,9 +115,18 @@ def write_leaf_mnist_fixture(
             blob["user_data"][uid] = {
                 "x": xr[sl].tolist(), "y": y[sl].tolist(),
             }
-    for split, blob in (("train", train_blob), ("test", test_blob)):
+    # tmp+rename with the probe (train json, names[0]) renamed LAST, per the
+    # fixture_util contract: a crash at any point leaves no probe file, so
+    # prepare() treats the marker as stale and regenerates cleanly
+    staged: list[tuple[Path, Path]] = []
+    for split, blob in (("test", test_blob), ("train", train_blob)):
         d = out / split
         d.mkdir(parents=True, exist_ok=True)
-        with open(d / f"all_data_niid_0_keep_0_{split}_9.json", "w") as f:
+        final = d / f"all_data_niid_0_keep_0_{split}_9.json"
+        tmp = final.with_name(final.name + ".tmp")
+        with open(tmp, "w") as f:
             json.dump(blob, f)
+        staged.append((tmp, final))
+    for tmp, final in staged:  # test first, train (probe) last
+        tmp.replace(final)
     return out

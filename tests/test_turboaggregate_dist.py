@@ -10,6 +10,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from fedml_tpu.algorithms.fedavg_distributed import init_template
 from fedml_tpu.algorithms.turboaggregate import dequantize
 from fedml_tpu.algorithms.turboaggregate_dist import TAMessage, run_turboaggregate
 from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
@@ -44,30 +45,44 @@ def _trainer():
     )
 
 
-def _expected_fedavg(trainer, train, template, rounds):
-    """The same round math executed openly: weighted mean of local models,
-    with the protocol's exact rng formulas."""
+def _survivor_fedavg(trainer, train, workers, exclude=(), round_idx=0,
+                     template=None):
+    """One-round open-math oracle: weighted FedAvg over the non-excluded
+    ranks with the protocol's exact rng formulas, renormalized over the
+    survivors. ``template`` is the round's starting global (fresh init when
+    None)."""
+    if template is None:
+        template, _, _ = init_template(trainer, train.arrays, BATCH, 0)
     local_train = jax.jit(make_local_train(trainer))
-    flat_t, desc = pack_pytree(jax.tree.map(np.asarray, template))
+    locals_, ns = [], []
+    for rank in range(1, workers + 1):
+        if rank in exclude:
+            continue
+        ci = (rank - 1) % train.num_clients
+        batches, weights = stack_cohort(
+            train, np.asarray([ci]), BATCH,
+            rng=np.random.RandomState(1000 + round_idx),
+        )
+        batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
+        new_vars, _ = local_train(
+            template, batches, jax.random.key(rank * 100003 + round_idx)
+        )
+        locals_.append(jax.tree.map(np.asarray, new_vars))
+        ns.append(float(weights[0]))
+    w = np.asarray(ns) / sum(ns)
+    return jax.tree.map(
+        lambda *leaves: np.sum([wi * l for wi, l in zip(w, leaves)], axis=0),
+        *locals_,
+    )
+
+
+def _expected_fedavg(trainer, train, template, rounds):
+    """Multi-round oracle: the one-round survivor oracle iterated with the
+    evolving global as each round's template."""
     global_vars = template
     for r in range(rounds):
-        locals_, ns = [], []
-        for rank in range(1, WORKERS + 1):
-            ci = (rank - 1) % train.num_clients
-            batches, weights = stack_cohort(
-                train, np.asarray([ci]), BATCH,
-                rng=np.random.RandomState(1000 + r),
-            )
-            batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
-            new_vars, _ = local_train(
-                global_vars, batches, jax.random.key(rank * 100003 + r)
-            )
-            locals_.append(jax.tree.map(np.asarray, new_vars))
-            ns.append(float(weights[0]))
-        w = np.asarray(ns) / sum(ns)
-        global_vars = jax.tree.map(
-            lambda *leaves: np.sum([wi * l for wi, l in zip(w, leaves)], axis=0),
-            *locals_,
+        global_vars = _survivor_fedavg(
+            trainer, train, WORKERS, round_idx=r, template=global_vars
         )
     return global_vars
 
@@ -211,29 +226,126 @@ def test_pre_share_drop_recovers_via_inclusion_set():
     )
 
     # open-math oracle over the survivors only, renormalized
-    template, _, _ = __import__(
-        "fedml_tpu.algorithms.fedavg_distributed", fromlist=["init_template"]
-    ).init_template(trainer, train.arrays, BATCH, 0)
-    local_train = jax.jit(make_local_train(trainer))
-    locals_, ns = [], []
-    for rank in range(1, WORKERS + 1):
-        if rank == dead:
-            continue
-        ci = (rank - 1) % train.num_clients
-        batches, weights = stack_cohort(
-            train, np.asarray([ci]), BATCH, rng=np.random.RandomState(1000),
-        )
-        batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
-        new_vars, _ = local_train(template, batches, jax.random.key(rank * 100003))
-        locals_.append(jax.tree.map(np.asarray, new_vars))
-        ns.append(float(weights[0]))
-    w = np.asarray(ns) / sum(ns)
-    expected = jax.tree.map(
-        lambda *leaves: np.sum([wi * l for wi, l in zip(w, leaves)], axis=0),
-        *locals_,
-    )
+    expected = _survivor_fedavg(trainer, train, WORKERS, exclude=(dead,))
     for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+class _PartialShareComm(LoopbackCommManager):
+    """Dies MID-share-leg: its peer shares reach only ``reached`` ranks, and
+    nothing after — the some-but-not-all delivery case."""
+
+    def __init__(self, fabric, rank, reached):
+        super().__init__(fabric, rank)
+        self._reached = set(reached)
+
+    def send_message(self, msg: Message) -> None:
+        t = msg.get_type()
+        if t == TAMessage.MSG_TYPE_C2C_SHARE:
+            if msg.get_receiver_id() in self._reached:
+                super().send_message(msg)
+            return
+        if t in (TAMessage.MSG_TYPE_C2S_SHARE_SUM,
+                 TAMessage.MSG_TYPE_C2S_SHARE_REPORT):
+            return
+        super().send_message(msg)
+
+
+def test_partial_share_delivery_resubmission_closes_round():
+    """Deadlock regression: the dying client delivered its shares to SOME
+    peers (who submit full-set share-sums) but not others. The agreed
+    inclusion set must reach the full-set submitters too, and their
+    RESUBMISSION over the agreed subset must close the round — with t+1=3
+    equal to the survivor count, no single bucket could otherwise reach
+    t+1 and the round would stall forever."""
+    train, _ = gaussian_blobs(n_clients=WORKERS, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    dead = WORKERS  # rank 4 dies mid-share-leg; its share reaches 1 and 2 only
+
+    fabric = LoopbackFabric(WORKERS + 1)
+
+    def make_comm(rank):
+        if rank == dead:
+            return _PartialShareComm(fabric, rank, reached=(1, 2))
+        return LoopbackCommManager(fabric, rank)
+
+    got = run_turboaggregate(
+        trainer, train, WORKERS, 1, BATCH, make_comm,
+        seed=0, round_timeout=1.0, share_timeout=0.3,
+        threshold=2,  # t+1 = 3 = exactly the survivor count
+    )
+
+    # oracle: open FedAvg over the survivors, weight-renormalized
+    expected = _survivor_fedavg(trainer, train, WORKERS, exclude=(dead,))
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+def test_full_bucket_precedes_subset_recovery():
+    """Privacy guard: when >= t+1 full-set share-sums already arrived, a
+    share report must NOT trigger subset recovery (the server could then
+    interpolate both the full and subset polynomials and difference out the
+    dead client's individual update). The round closes on the full bucket —
+    whose sums carry the dead client's delivered shares — so the aggregate
+    equals open FedAvg over ALL clients, dead one included."""
+    workers = 5
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    dead = workers  # delivers shares to ranks 1-3 only, then dies
+
+    fabric = LoopbackFabric(workers + 1)
+
+    def make_comm(rank):
+        if rank == dead:
+            return _PartialShareComm(fabric, rank, reached=(1, 2, 3))
+        return LoopbackCommManager(fabric, rank)
+
+    got = run_turboaggregate(
+        trainer, train, workers, 1, BATCH, make_comm,
+        seed=0, round_timeout=5.0, share_timeout=0.3,
+        threshold=1,  # 3 full-set sums >= t+1=2: reconstructable already
+    )
+
+    # oracle: open FedAvg over ALL workers — the dead client's update was
+    # shared before it died and is inside every full-set sum
+    expected = _survivor_fedavg(trainer, train, workers)
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
+class _NoShareDeliveryComm(LoopbackCommManager):
+    """Loses every C2C share (but stays alive to report): with ALL clients
+    on this transport, every report holds only the reporter's own share and
+    the intersection is empty."""
+
+    def send_message(self, msg: Message) -> None:
+        if msg.get_type() == TAMessage.MSG_TYPE_C2C_SHARE:
+            return
+        super().send_message(msg)
+
+
+def test_empty_inclusion_set_refused_round_skipped():
+    """Disjoint reports intersect to the empty set: the server must refuse
+    to broadcast it (an aggregate over < t+1 clients leaks near-individual
+    updates; an empty one would np.stack([]) on clients) and skip the round
+    with the global model unchanged — not stall or crash."""
+    train, _ = gaussian_blobs(n_clients=WORKERS, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    fabric = LoopbackFabric(WORKERS + 1)
+
+    got = run_turboaggregate(
+        trainer, train, WORKERS, 1, BATCH,
+        lambda r: _NoShareDeliveryComm(fabric, r),
+        seed=0, share_timeout=0.3, threshold=1,
+    )
+
+    # the only round was skipped: final == initial template, exactly
+    template, _, _ = init_template(trainer, train.arrays, BATCH, 0)
+    for a, b in zip(jax.tree.leaves(template), jax.tree.leaves(got)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
 def test_pre_share_drop_recovers_without_round_timeout():
