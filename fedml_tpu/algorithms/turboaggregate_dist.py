@@ -190,6 +190,7 @@ class TAServerManager(ServerManager):
     # -- aggregation --------------------------------------------------------
 
     def _on_share_sum(self, msg: Message) -> None:
+        resend_to = None
         with self._lock:
             if int(msg.get(TAMessage.KEY_ROUND)) != self.round_idx:
                 return  # late arrival from a timed-out round
@@ -198,9 +199,17 @@ class TAServerManager(ServerManager):
                 tuple(int(i) for i in include) if include is not None
                 else tuple(range(1, self.worker_num + 1))
             )
-            self._share_sums[msg.get_sender_id()] = (
+            sender = msg.get_sender_id()
+            self._share_sums[sender] = (
                 include, np.asarray(msg.get(TAMessage.KEY_SHARE))
             )
+            if self._include_sent and include != tuple(self._include_set):
+                # a share-sum arriving AFTER the inclusion-set decision with
+                # a different set (e.g. a slow full-set holder) never saw the
+                # broadcast — resend it so this sender can resubmit into the
+                # agreed bucket, otherwise the round can stall with subset
+                # sums and full sums that never reach t+1 in any one bucket
+                resend_to = (sender, self._include_set, self.round_idx)
             got = len(self._share_sums)
             if (got == 1 and self.round_timeout is not None
                     and self._timer is None and not self._timed_out):
@@ -213,11 +222,14 @@ class TAServerManager(ServerManager):
                 self._timer = threading.Timer(self.round_timeout, self._timeout)
                 self._timer.daemon = True
                 self._timer.start()
-            if got < self.worker_num and not (
+            closing = got >= self.worker_num or (
                 self._timed_out and got >= self.threshold + 1
-            ):
-                return
-        self._close_round()
+            )
+        if resend_to is not None:
+            sender, inc, rnd = resend_to
+            self._send_include(inc, [sender], rnd)
+        if closing:
+            self._close_round()
 
     def _on_share_report(self, msg: Message) -> None:
         """Pre-share dropout recovery, leg 1: a client whose share wait timed

@@ -157,3 +157,158 @@ def write_fed_cifar100_h5_fixture(
     tmp_test.rename(out / "fed_cifar100_test.h5")
     tmp_train.rename(out / "fed_cifar100_train.h5")
     return out
+
+
+# -- StackOverflow next-word-prediction fixture ------------------------------
+
+
+def stackoverflow_markov_source(active_words: int = 2000, seed: int = 0,
+                                alpha: float = 0.002):
+    """The fixture's generating process: a word-level Markov chain over
+    ``active_words`` states with sparse Dirichlet(``alpha``) transition
+    rows. Returns (transition matrix [A, A], stationary distribution [A])
+    — the analytic handle repro ceilings are computed from. ``alpha``
+    controls how predictable transitions are: at A=2000, alpha=0.002 makes
+    the Bayes-optimal interior-transition accuracy ~34% (a real learnable
+    signal above the eos-only floor), while larger alphas flatten the rows
+    toward an unlearnable uniform chain."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(
+        np.ones(active_words) * alpha, size=active_words
+    ).astype(np.float64)
+    pi = np.full(active_words, 1.0 / active_words)
+    for _ in range(200):  # power iteration to the stationary distribution
+        nxt = pi @ trans
+        if np.abs(nxt - pi).max() < 1e-12:
+            pi = nxt
+            break
+        pi = nxt
+    return trans, pi / pi.sum()
+
+
+def stackoverflow_bayes_ceiling(active_words: int = 2000, seed: int = 0,
+                                sentence_len: int = 10,
+                                alpha: float = 0.002) -> float:
+    """Exact Bayes-optimal next-token accuracy of the fixture under the
+    loader's tokenization: per sentence the model predicts bos->w1
+    (optimum: argmax pi), sentence_len-1 interior transitions (optimum:
+    argmax_j T[i, j]), and w_last->eos (deterministic — sentence length is
+    fixed). No predictor can beat the average of those three terms. The
+    matching NO-LEARNING floor is ``1 / (sentence_len + 1)`` — a model
+    that only ever predicts eos gets exactly that — so results should be
+    read as (acc - floor) / (ceiling - floor), the fraction of learnable
+    signal captured."""
+    trans, pi = stackoverflow_markov_source(active_words, seed, alpha)
+    first = float(pi.max())
+    interior = float(np.sum(pi * trans.max(axis=1)))
+    return (first + (sentence_len - 1) * interior + 1.0) / (sentence_len + 1)
+
+
+def write_stackoverflow_nwp_fixture(
+    out_dir: str | Path,
+    n_clients: int = 342_477,
+    seed: int = 0,
+    vocab_size: int = 10_000,
+    active_words: int = 2000,
+    sentence_len: int = 10,
+    min_sent: int = 2,
+    max_sent: int = 64,
+    test_clients: int = 10_000,
+    alpha: float = 0.002,
+) -> Path:
+    """Write stackoverflow_{train,test}.h5 + stackoverflow.word_count in the
+    real TFF schema (``examples/<client>/tokens`` string sentences;
+    stackoverflow_nwp/data_loader.py:96 + vocab dicts) at the row's full
+    342,477-client population scale.
+
+    Sentences are fixed-length word sequences from
+    :func:`stackoverflow_markov_source` — a known generating process, so the
+    row's attainable accuracy is the analytic
+    :func:`stackoverflow_bayes_ceiling`. Only ``active_words`` of the 10k
+    vocab ever occur (a Zipf-like head); per-client sentence counts are
+    lognormal in [min_sent, max_sent] — population heterogeneity without
+    per-client distribution shift. The first ``test_clients`` clients get a
+    held-out test shard. Idempotency and real-data preservation follow the
+    shared fixture_util contract.
+    """
+    import h5py
+
+    from fedml_tpu.data import fixture_util
+
+    out = Path(out_dir)
+    config = {
+        "n_clients": n_clients, "seed": seed, "vocab_size": vocab_size,
+        "active_words": active_words, "sentence_len": sentence_len,
+        "min_sent": min_sent, "max_sent": max_sent,
+        "test_clients": test_clients, "alpha": alpha,
+    }
+    files = ["stackoverflow_train.h5", "stackoverflow_test.h5",
+             "stackoverflow.word_count"]
+    if not fixture_util.prepare(out, "stackoverflow_nwp", config, files):
+        return out
+    rng = np.random.RandomState(seed)
+    trans, pi = stackoverflow_markov_source(active_words, seed, alpha)
+    cum = np.cumsum(trans, axis=1).astype(np.float32)
+    words = np.asarray([f"w{k}" for k in range(vocab_size)], dtype=object)
+
+    sizes = np.clip(
+        np.exp(rng.normal(np.log(6.0), 0.8, n_clients)).astype(int),
+        min_sent, max_sent,
+    )
+    n_test_sent = 2  # held-out sentences per test-shard client
+
+    def sample_sentences(n):
+        """[n, sentence_len] Markov word-id sequences, vectorized."""
+        toks = np.empty((n, sentence_len), np.int32)
+        toks[:, 0] = rng.choice(active_words, size=n, p=pi)
+        u = rng.rand(n, sentence_len - 1).astype(np.float32)
+        for t in range(1, sentence_len):
+            rows = cum[toks[:, t - 1]]
+            # clamp BEFORE the next step's row indexing: float32 cumsum can
+            # top out fractionally below u, yielding index == active_words
+            toks[:, t] = np.minimum(
+                (rows < u[:, t - 1 : t]).sum(axis=1), active_words - 1
+            )
+        return toks
+
+    tmp_train = out / "stackoverflow_train.h5.tmp"
+    tmp_test = out / "stackoverflow_test.h5.tmp"
+    tmp_vocab = out / "stackoverflow.word_count.tmp"
+    # vocab file: one "word count" line per word, most-frequent first — the
+    # loader assigns ids by line order, so active words get ids 0..A-1
+    with open(tmp_vocab, "w") as fh:
+        for k in range(vocab_size):
+            fh.write(f"w{k} {max(vocab_size - k, 1)}\n")
+    chunk = 4096
+    dt = h5py.string_dtype()
+    with h5py.File(tmp_train, "w") as ftr, h5py.File(tmp_test, "w") as fte:
+        gtr = ftr.create_group("examples")
+        gte = fte.create_group("examples")
+        for lo in range(0, n_clients, chunk):
+            csizes = sizes[lo : lo + chunk]
+            in_test = lo < test_clients
+            extra = n_test_sent if in_test else 0
+            total = int(csizes.sum()) + extra * len(csizes)
+            toks = sample_sentences(total)
+            sents = np.asarray(
+                [" ".join(words[row]) for row in toks], dtype=object
+            )
+            cursor = 0
+            for ci, sz in enumerate(csizes):
+                cid = f"{lo + ci:08d}"
+                take = int(sz) + (extra if (lo + ci) < test_clients else 0)
+                mine = sents[cursor : cursor + take]
+                cursor += take
+                if (lo + ci) < test_clients:
+                    gte.create_group(cid).create_dataset(
+                        "tokens", data=list(mine[:n_test_sent]), dtype=dt
+                    )
+                    mine = mine[n_test_sent:]
+                gtr.create_group(cid).create_dataset(
+                    "tokens", data=list(mine), dtype=dt
+                )
+    # probe file (train) LAST — see write_femnist_h5_fixture
+    tmp_vocab.rename(out / "stackoverflow.word_count")
+    tmp_test.rename(out / "stackoverflow_test.h5")
+    tmp_train.rename(out / "stackoverflow_train.h5")
+    return out

@@ -159,7 +159,11 @@ def load_stackoverflow_nwp(
     bos, eos, oov = vocab_size + 1, vocab_size + 2, vocab_size + 3
 
     def _tokenize(sentence: str):
-        toks = [word_id.get(w, oov) + 1 for w in sentence.split()]
+        # known words occupy ids 1..vocab_size (0 = pad); OOV is already an
+        # absolute special id — adding 1 to it would index past the
+        # (vocab_size+4)-entry embedding and silently clamp
+        toks = [word_id[w] + 1 if w in word_id else oov
+                for w in sentence.split()]
         return [bos] + toks[: seq_len - 1] + [eos]
 
     def _read(path):

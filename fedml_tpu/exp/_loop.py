@@ -16,9 +16,14 @@ import logging
 import time
 
 
-def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0) -> tuple[list, float]:
+def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
+               stop_when=None) -> tuple[list, float]:
     """Returns (records, wall_seconds). On an exception the loop stops and
-    whatever completed is returned — callers report partial results."""
+    whatever completed is returned — callers report partial results.
+    ``stop_when(records) -> bool`` is consulted after every eval round: a
+    True return stops the run early (saturation guard — a curve pinned at
+    its fixture ceiling carries no further convergence signal; callers
+    report the stop round)."""
     from fedml_tpu.core import rng as rnglib
 
     records: list[dict] = []
@@ -34,7 +39,8 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0) -> tuple[li
                     r, variables, server_state, root
                 )
                 rec = {"round": r, **{k: float(v) for k, v in m.items()}}
-                if (r + 1) % freq == 0 or r == cfg.comm_round - 1:
+                evaled = (r + 1) % freq == 0 or r == cfg.comm_round - 1
+                if evaled:
                     rec.update(sim.eval_record(variables))
             except Exception:
                 logging.exception(
@@ -45,6 +51,11 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0) -> tuple[li
             records.append(rec)
             f.write(json.dumps(rec) + "\n")
             f.flush()
+            if evaled and stop_when is not None and stop_when(records):
+                logging.info(
+                    "stop_when fired at round %d — stopping early", r
+                )
+                break
             if round_sleep:
                 time.sleep(round_sleep)
     return records, (time.time() - t0) or 1.0

@@ -17,6 +17,13 @@ TPU-native equivalents:
    pytorch_mnn round-trip): a deterministic leaf ordering so an on-device
    runtime holding "a list of weight arrays" can exchange parameters with
    the server model, both directions, loss-free.
+3. :func:`params_to_nested_lists` / :func:`nested_lists_to_params` — the
+   reference's ``is_mobile`` WIRE format
+   (fedml_api/distributed/fedavg/utils.py:7-16
+   ``transform_tensor_to_list`` / ``transform_list_to_tensor``): a
+   JSON-serializable dict keyed by parameter name whose values are the
+   ``.tolist()`` nesting of each array. A mobile client speaking the
+   reference's JSON can exchange models with this server unchanged.
 """
 
 from __future__ import annotations
@@ -59,6 +66,49 @@ def flat_list_to_params(flat: list[np.ndarray], template: Pytree) -> Pytree:
         if arr.shape != want:
             arr = arr.reshape(want)  # reference reshapes on mismatch too
         leaves[slot] = arr
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# -- reference is_mobile wire format (fedavg/utils.py:7-16) ------------------
+
+
+def _path_key(path) -> str:
+    """'/'-joined tree path — the parameter-name key of the wire dict."""
+    return "/".join(
+        str(getattr(e, "key", getattr(e, "idx", getattr(e, "name", e))))
+        for e in path
+    )
+
+
+def params_to_nested_lists(params: Pytree) -> dict[str, list]:
+    """Reference ``transform_tensor_to_list``: dict keyed by parameter name,
+    each value the ``.tolist()`` nesting of the array (nesting depth ==
+    array ndim). Keys are emitted in the same deterministic path-sorted
+    order as :func:`params_to_flat_list`, so ``json.dumps`` round-trips
+    with ordering preserved."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    leaves.sort(key=lambda kv: jax.tree_util.keystr(kv[0]))
+    return {_path_key(p): np.asarray(v).tolist() for p, v in leaves}
+
+
+def nested_lists_to_params(obj: dict[str, list], template: Pytree) -> Pytree:
+    """Reference ``transform_list_to_tensor``: rebuild parameters from the
+    nested-list wire dict. Values are cast to float32 exactly as the
+    reference's ``torch.from_numpy(np.asarray(v)).float()`` does, then to
+    the template leaf's dtype."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, tmpl in paths:
+        key = _path_key(path)
+        if key not in obj:
+            raise ValueError(f"wire dict is missing parameter {key!r}")
+        arr = np.asarray(obj[key], dtype=np.float32)
+        want = np.shape(tmpl)
+        if arr.shape != want:
+            raise ValueError(
+                f"parameter {key!r} has shape {arr.shape}, expected {want}"
+            )
+        leaves.append(arr.astype(np.asarray(tmpl).dtype))
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 

@@ -66,6 +66,16 @@ class SimConfig:
     # convolutions inside a while loop take XLA:CPU's single-threaded slow
     # path, ~100x slower than the same round dispatched directly.
     block_dispatch: bool | None = None
+    # How the cohort's clients execute inside the round program:
+    # "vmap" (default) trains every local client simultaneously — best MXU
+    # utilization for small models, but peak HBM scales with C_local
+    # (each live client holds params + optimizer state + activations);
+    # "scan" trains them sequentially (lax.map), holding ONE client's
+    # transient state at a time — the big-model mode (e.g. the LM bench:
+    # per-client transformer state is GBs, and its matmuls already fill the
+    # MXU without cross-client batching, so scan costs ~nothing and frees
+    # C_local-1 clients' worth of HBM for longer sequences / bigger batches).
+    cohort_execution: str = "vmap"
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
@@ -101,6 +111,12 @@ class FedSim:
         self.trainer = trainer
         self.train_data = train_data
         self.config = config
+        if config.cohort_execution not in ("vmap", "scan"):
+            raise ValueError(
+                f"unknown cohort_execution {config.cohort_execution!r} "
+                "(expected 'vmap' or 'scan') — a silent fallback here would "
+                "benchmark or OOM the wrong execution mode"
+            )
         self.aggregator = aggregator or fedavg_aggregator()
         self.mesh = mesh if mesh is not None else meshlib.client_mesh()
         # per-client persistent models (decentralized/gossip FL): each client
@@ -265,9 +281,23 @@ class FedSim:
         # per-client mode: each client starts from its own model (stacked
         # leading axis); broadcast mode: everyone starts from the global
         var_axis = 0 if self._per_client else None
-        local_vars, train_metrics = jax.vmap(
-            self._local_train, in_axes=(var_axis, 0, 0, 0)
-        )(global_variables, batches, keys, num_steps)
+        if self.config.cohort_execution == "scan":
+            # sequential clients: one client's optimizer state + activations
+            # live at a time (outputs still stack incrementally to [C, ...])
+            if self._per_client:
+                local_vars, train_metrics = jax.lax.map(
+                    lambda args: self._local_train(*args),
+                    (global_variables, batches, keys, num_steps),
+                )
+            else:
+                local_vars, train_metrics = jax.lax.map(
+                    lambda args: self._local_train(global_variables, *args),
+                    (batches, keys, num_steps),
+                )
+        else:
+            local_vars, train_metrics = jax.vmap(
+                self._local_train, in_axes=(var_axis, 0, 0, 0)
+            )(global_variables, batches, keys, num_steps)
         # Full cohort stack for the aggregator (robust rules need every
         # client's model: median/krum/clipping are cross-client).
         gather = partial(jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True)
@@ -682,16 +712,27 @@ class FedSim:
     def evaluate(self, variables) -> dict[str, float]:
         if not self._can_eval:
             return {}
-        out = {}
+        # enqueue BOTH eval programs before fetching anything: JAX dispatch
+        # is async, so the train and test programs overlap on device and the
+        # host pays ONE round-trip (device_get) instead of four synchronous
+        # float() fetches — on remote-attached chips (tunneled TPU) the
+        # per-fetch latency, not the inference FLOPs, dominates eval time
         train_m = (
             self._eval_gather_fn(variables, self._dataset, self._train_eval_idx)
             if self._train_eval_idx is not None
             else self._eval_fn(variables, self._train_eval_batches)
         )
-        out["Train/Acc"] = float(train_m["Acc"])
-        out["Train/Loss"] = float(train_m["Loss"])
-        if self._test_batches is not None:
-            test_m = self._eval_fn(variables, self._test_batches)
+        test_m = (
+            self._eval_fn(variables, self._test_batches)
+            if self._test_batches is not None
+            else None
+        )
+        train_m, test_m = jax.device_get((train_m, test_m))
+        out = {
+            "Train/Acc": float(train_m["Acc"]),
+            "Train/Loss": float(train_m["Loss"]),
+        }
+        if test_m is not None:
             out["Test/Acc"] = float(test_m["Acc"])
             out["Test/Loss"] = float(test_m["Loss"])
         return out

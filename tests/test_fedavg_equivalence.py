@@ -101,6 +101,27 @@ def test_partial_participation_runs():
     assert np.isfinite(history[-1]["Train/Loss"])
 
 
+def test_scan_cohort_execution_matches_vmap():
+    """cohort_execution='scan' (sequential clients, one client's optimizer
+    state + activations live at a time — the big-model HBM mode) must
+    produce bit-compatible results with the default vmap execution."""
+    train, test = gaussian_blobs(n_clients=6, samples_per_client=24, seed=3)
+    trainer = _make_trainer(lr=0.2, epochs=2)
+    base = SimConfig(
+        client_num_in_total=6, client_num_per_round=4, batch_size=8,
+        comm_round=3, epochs=2, frequency_of_the_test=3, seed=0,
+    )
+    vmap_vars, vmap_hist = FedSim(trainer, train, test, base).run()
+    scan_vars, scan_hist = FedSim(
+        trainer, train, test,
+        dataclasses.replace(base, cohort_execution="scan"),
+    ).run()
+    for a, b in zip(jax.tree.leaves(vmap_vars), jax.tree.leaves(scan_vars)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert vmap_hist[-1].keys() == scan_hist[-1].keys()
+
+
 def test_client_sampling_matches_reference_semantics():
     from fedml_tpu.core.rng import sample_clients
 

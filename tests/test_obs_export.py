@@ -143,6 +143,56 @@ def test_flat_list_roundtrip():
         flat_list_to_params(flat[:-1], v["params"])
 
 
+def test_mobile_wire_format_matches_reference_roundtrip():
+    """Interop with the reference's ``is_mobile`` JSON
+    (fedavg/utils.py:7-16): our wire dict must survive json.dumps, convert
+    through the reference's OWN ``transform_list_to_tensor`` logic (torch)
+    byte-exactly, and come back through ``transform_tensor_to_list``'s
+    output into identical parameters — same nesting, same ordering."""
+    import torch
+
+    from fedml_tpu.models.cnn import LeNet
+    from fedml_tpu.models.export import (
+        nested_lists_to_params,
+        params_to_nested_lists,
+    )
+
+    model = LeNet(num_classes=10)
+    v = model.init(jax.random.key(0), jnp.ones((1, 28, 28, 1)))
+    params = jax.tree.map(np.asarray, v["params"])
+
+    wire = params_to_nested_lists(params)
+    # nesting depth of each value equals the array's ndim (the reference's
+    # .tolist() contract), and key order is deterministic
+    flat = params_to_flat_list(params)
+    for arr, (key, val) in zip(flat, wire.items()):
+        depth, probe = 0, val
+        while isinstance(probe, list):
+            depth, probe = depth + 1, probe[0]
+        assert depth == arr.ndim, key
+
+    # through real JSON, then the reference's transform_list_to_tensor
+    # verbatim (utils.py:7-10): torch.from_numpy(np.asarray(v)).float()
+    decoded = json.loads(json.dumps(wire))
+    as_tensors = {
+        k: torch.from_numpy(np.asarray(p)).float() for k, p in decoded.items()
+    }
+    for arr, (key, t) in zip(flat, as_tensors.items()):
+        np.testing.assert_array_equal(t.numpy(), arr, err_msg=key)
+
+    # and the reference's transform_tensor_to_list output (utils.py:13-16)
+    # rebuilds our params exactly
+    back_wire = {k: t.detach().numpy().tolist() for k, t in as_tensors.items()}
+    rebuilt = nested_lists_to_params(back_wire, params)
+    for a, b in zip(jax.tree.leaves(rebuilt), jax.tree.leaves(params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # missing / misshapen parameters fail loudly, like the reference's
+    # aligned-layer assumption
+    with pytest.raises(ValueError, match="missing"):
+        nested_lists_to_params({}, params)
+
+
 def test_stablehlo_export_roundtrip(tmp_path):
     model = LogisticRegression(num_classes=3)
     x = jnp.ones((2, 8))

@@ -62,6 +62,45 @@ def test_repro_pipeline_converges_small(tmp_path):
     assert (tmp_path / "R.md").exists()
 
 
+def test_repro_femnist_lr_small(tmp_path):
+    """The Linear-table FEMNIST+LR row (exp/repro_femnist_lr.py) end-to-end
+    at small scale: real TFF h5 ingestion, LR trainer, built-in fixture
+    ceiling, REPRO section with the fraction-of-ceiling line."""
+    from fedml_tpu.exp.repro_femnist_lr import main
+
+    result = main([
+        "--client_num_in_total", "12", "--comm_round", "16",
+        "--client_num_per_round", "6", "--frequency_of_the_test", "4",
+        "--data_dir", str(tmp_path / "fem"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    assert result["clients"] == 12
+    assert 0.0 < result["fixture_ceiling"] <= 1.0
+    assert result["best_test_acc"] <= result["fixture_ceiling"] + 0.05
+    text = (tmp_path / "R.md").read_text()
+    assert "of ceiling" in text and "femnist_lr" in text
+
+
+def test_markov_bayes_ceiling_matches_empirical():
+    """The analytic Bayes optimum of the char-LM fixture must match the
+    empirical accuracy of the oracle predictor argmax_j T[i,j] on freshly
+    generated data (same seed -> same transition matrix)."""
+    from fedml_tpu.data.registry import synthetic_char_lm
+    from fedml_tpu.exp.repro_ceilings import markov_bayes_ceiling
+
+    vocab, seed = 30, 5
+    analytic = markov_bayes_ceiling(vocab=vocab, seed=seed)
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab) * 0.05, size=vocab)
+    train, test, _ = synthetic_char_lm(
+        n_clients=40, vocab=vocab, seq_len=50, samples=30, seed=seed
+    )
+    pred = trans.argmax(axis=1)
+    hits = (pred[test["x"]] == test["y"]).mean()
+    assert abs(hits - analytic) < 0.03, (hits, analytic)
+
+
 @pytest.mark.slow
 def test_repro_full_scale(tmp_path):
     from fedml_tpu.exp.repro_femnist_cnn import main

@@ -1,0 +1,129 @@
+"""The StackOverflow-NWP reproduction pipeline (exp/repro_stackoverflow_nwp.py).
+
+Quick tests run the pipeline end-to-end at small scale through the real
+schema (h5 string sentences + word_count vocab -> tff_h5 tokenizer); the
+342,477-client full-population run is the committed REPRO.md artifact."""
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from fedml_tpu.data.tff_fixture import (
+    stackoverflow_bayes_ceiling,
+    stackoverflow_markov_source,
+    write_stackoverflow_nwp_fixture,
+)
+
+
+def test_fixture_is_real_tff_schema(tmp_path):
+    out = write_stackoverflow_nwp_fixture(
+        tmp_path / "so", n_clients=30, seed=1, test_clients=5,
+        active_words=50, vocab_size=200,
+    )
+    with h5py.File(out / "stackoverflow_train.h5", "r") as f:
+        cids = sorted(f["examples"].keys())
+        assert len(cids) == 30
+        toks = f["examples"][cids[0]]["tokens"][()]
+        sent = toks[0].decode() if isinstance(toks[0], bytes) else str(toks[0])
+        assert all(w.startswith("w") for w in sent.split())
+    with h5py.File(out / "stackoverflow_test.h5", "r") as f:
+        assert len(f["examples"].keys()) == 5  # held-out shard
+    vocab_lines = (out / "stackoverflow.word_count").read_text().splitlines()
+    assert len(vocab_lines) == 200
+    assert vocab_lines[0].split()[0] == "w0"
+    # idempotent
+    assert write_stackoverflow_nwp_fixture(
+        tmp_path / "so", n_clients=30, seed=1, test_clients=5,
+        active_words=50, vocab_size=200,
+    ) == out
+
+
+def test_fixture_loads_through_real_tokenizer(tmp_path):
+    from fedml_tpu.data.tff_h5 import load_stackoverflow_nwp
+
+    write_stackoverflow_nwp_fixture(
+        tmp_path / "so", n_clients=20, seed=2, test_clients=4,
+        active_words=50, vocab_size=200, sentence_len=8,
+    )
+    train, test, _ = load_stackoverflow_nwp(
+        tmp_path / "so", vocab_size=200, seq_len=20, limit_clients=None
+    )
+    assert train.num_clients == 20
+    bos, eos = 201, 202
+    assert (train.arrays["x"][:, 0] == bos).all()
+    # each target row ends its sentence with eos then pad
+    row = train.arrays["y"][0]
+    assert eos in row
+    assert (row[np.argmax(row == eos) + 1:] == 0).all()
+    # heterogeneous client sizes
+    sizes = {len(train.partition[i]) for i in range(20)}
+    assert len(sizes) > 1
+
+
+def test_bayes_ceiling_matches_empirical_oracle(tmp_path):
+    """The analytic ceiling must match the accuracy of the oracle that knows
+    the generating chain (argmax transitions, argmax-stationary after bos,
+    eos after the fixed sentence length), measured on loader output."""
+    from fedml_tpu.data.tff_h5 import load_stackoverflow_nwp
+
+    A, V, SL = 50, 200, 8
+    write_stackoverflow_nwp_fixture(
+        tmp_path / "so", n_clients=300, seed=3, test_clients=10,
+        active_words=A, vocab_size=V, sentence_len=SL,
+    )
+    train, _, _ = load_stackoverflow_nwp(
+        tmp_path / "so", vocab_size=V, seq_len=20, limit_clients=None
+    )
+    analytic = stackoverflow_bayes_ceiling(A, seed=3, sentence_len=SL)
+    trans, pi = stackoverflow_markov_source(A, seed=3)
+    bos, eos = V + 1, V + 2
+    x, y = train.arrays["x"], train.arrays["y"]
+    mask = train.arrays["mask"].astype(bool)
+    # oracle prediction per position (loader ids are word_id + 1)
+    pred = np.zeros_like(x)
+    pred[x == bos] = int(pi.argmax()) + 1
+    is_word = (x >= 1) & (x <= A)
+    word_pred = trans.argmax(axis=1) + 1
+    pred[is_word] = word_pred[x[is_word] - 1]
+    # after the SL-th word the only valid target is eos
+    pred[:, SL] = eos
+    acc = (pred == y)[mask].mean()
+    assert abs(acc - analytic) < 0.02, (acc, analytic)
+
+
+def test_repro_pipeline_small(tmp_path):
+    """End-to-end at toy scale: fixture, real tokenizer, host-staged engine,
+    ceiling-bearing REPRO section."""
+    from fedml_tpu.exp.repro_stackoverflow_nwp import main
+
+    result = main([
+        "--client_num_in_total", "40", "--comm_round", "8",
+        "--client_num_per_round", "10", "--frequency_of_the_test", "4",
+        "--test_clients", "8",
+        # small LSTM + vocab: the full 670-hidden / 10k-vocab compile
+        # belongs to the slow full-population test
+        "--embedding_dim", "16", "--hidden_size", "32",
+        "--vocab_size", "300",
+        "--data_dir", str(tmp_path / "so"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    assert result["clients"] == 40
+    assert "fixture_bayes_ceiling" in result
+    text = (tmp_path / "R.md").read_text()
+    assert "stackoverflow_nwp" in text and "Bayes ceiling" in text
+    assert "host-staged" in text.lower() or "HOST-side" in text
+
+
+@pytest.mark.slow
+def test_repro_full_population(tmp_path):
+    from fedml_tpu.exp.repro_stackoverflow_nwp import main
+
+    result = main([
+        "--data_dir", str(tmp_path / "so"),
+        "--metrics_out", str(tmp_path / "m.jsonl"),
+        "--out", str(tmp_path / "R.md"),
+    ])
+    assert result["clients"] == 342_477
+    assert result["pct_of_ceiling"] > 80.0, result

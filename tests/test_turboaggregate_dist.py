@@ -315,6 +315,58 @@ def test_full_bucket_precedes_subset_recovery():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
 
 
+class _SlowSumComm(LoopbackCommManager):
+    """A client whose share-sum upload is DELAYED past the server's
+    inclusion-set decision (e.g. a first-round jit compile straggler)."""
+
+    def __init__(self, fabric, rank, delay):
+        super().__init__(fabric, rank)
+        self._delay = delay
+
+    def send_message(self, msg: Message) -> None:
+        if msg.get_type() == TAMessage.MSG_TYPE_C2S_SHARE_SUM:
+            import threading
+
+            t = threading.Timer(self._delay,
+                                lambda: super(_SlowSumComm, self).send_message(msg))
+            t.daemon = True
+            t.start()
+            return
+        super().send_message(msg)
+
+
+def test_late_full_set_submitter_receives_include_set():
+    """Deadlock regression: the dying client's share reached ONLY a slow
+    full-set holder whose share-sum arrives AFTER the inclusion-set
+    broadcast. The server must resend the agreed set to that submitter so
+    it can resubmit — with t+1=3 equal to the survivor count, the round
+    would otherwise hang forever with 2 subset sums + 1 full sum."""
+    train, _ = gaussian_blobs(n_clients=WORKERS, samples_per_client=30,
+                              num_classes=4, seed=2)
+    trainer = _trainer()
+    dead = WORKERS  # rank 4 delivers its share to rank 1 only, then dies
+
+    fabric = LoopbackFabric(WORKERS + 1)
+
+    def make_comm(rank):
+        if rank == dead:
+            return _PartialShareComm(fabric, rank, reached=(1,))
+        if rank == 1:  # full-set holder, but slow to upload
+            return _SlowSumComm(fabric, rank, delay=1.6)
+        return LoopbackCommManager(fabric, rank)
+
+    got = run_turboaggregate(
+        trainer, train, WORKERS, 1, BATCH, make_comm,
+        seed=0, round_timeout=0.8, share_timeout=0.3,
+        threshold=2,  # t+1 = 3 = exactly the survivor count
+    )
+
+    # agreed inclusion set = intersection of ranks 2,3's reports = {1,2,3}
+    expected = _survivor_fedavg(trainer, train, WORKERS, exclude=(dead,))
+    for a, b in zip(jax.tree.leaves(expected), jax.tree.leaves(got)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-4)
+
+
 class _NoShareDeliveryComm(LoopbackCommManager):
     """Loses every C2C share (but stays alive to report): with ALL clients
     on this transport, every report holds only the reporter's own share and
