@@ -163,19 +163,30 @@ def write_fed_cifar100_h5_fixture(
 
 
 def stackoverflow_markov_source(active_words: int = 2000, seed: int = 0,
-                                alpha: float = 0.002):
-    """The fixture's generating process: a word-level Markov chain over
-    ``active_words`` states with sparse Dirichlet(``alpha``) transition
-    rows. Returns (transition matrix [A, A], stationary distribution [A])
-    — the analytic handle repro ceilings are computed from. ``alpha``
-    controls how predictable transitions are: at A=2000, alpha=0.002 makes
-    the Bayes-optimal interior-transition accuracy ~34% (a real learnable
-    signal above the eos-only floor), while larger alphas flatten the rows
-    toward an unlearnable uniform chain."""
+                                alpha: float = 0.002, clusters: int = 50):
+    """The fixture's generating process: a CLUSTER-structured word-level
+    Markov chain — each of the ``active_words`` states belongs to one of
+    ``clusters`` word classes, and the next-word distribution depends only
+    on the class of the current word (``clusters`` sparse
+    Dirichlet(``alpha``) rows, shared across class members). Returns
+    (transition matrix [A, A], stationary distribution [A]) — the analytic
+    handle repro ceilings are computed from.
+
+    The cluster structure is what makes the fixture LEARNABLE the way
+    natural language is: an LSTM needs only the class identity of the
+    current word plus ``clusters`` output distributions (a low-rank
+    factorization), not a table of ``active_words`` unrelated rows — a
+    structureless table at the same Bayes accuracy is pure memorization
+    and no sequence model approaches its ceiling in bounded rounds.
+    ``alpha`` controls how predictable transitions are: at A=2000,
+    alpha=0.002 makes the Bayes-optimal interior-transition accuracy ~34%
+    (a real learnable signal above the eos-only floor)."""
     rng = np.random.RandomState(seed)
-    trans = rng.dirichlet(
-        np.ones(active_words) * alpha, size=active_words
+    class_rows = rng.dirichlet(
+        np.ones(active_words) * alpha, size=clusters
     ).astype(np.float64)
+    assign = rng.randint(0, clusters, active_words)
+    trans = class_rows[assign]
     pi = np.full(active_words, 1.0 / active_words)
     for _ in range(200):  # power iteration to the stationary distribution
         nxt = pi @ trans
@@ -188,7 +199,8 @@ def stackoverflow_markov_source(active_words: int = 2000, seed: int = 0,
 
 def stackoverflow_bayes_ceiling(active_words: int = 2000, seed: int = 0,
                                 sentence_len: int = 10,
-                                alpha: float = 0.002) -> float:
+                                alpha: float = 0.002,
+                                clusters: int = 50) -> float:
     """Exact Bayes-optimal next-token accuracy of the fixture under the
     loader's tokenization: per sentence the model predicts bos->w1
     (optimum: argmax pi), sentence_len-1 interior transitions (optimum:
@@ -198,7 +210,7 @@ def stackoverflow_bayes_ceiling(active_words: int = 2000, seed: int = 0,
     that only ever predicts eos gets exactly that — so results should be
     read as (acc - floor) / (ceiling - floor), the fraction of learnable
     signal captured."""
-    trans, pi = stackoverflow_markov_source(active_words, seed, alpha)
+    trans, pi = stackoverflow_markov_source(active_words, seed, alpha, clusters)
     first = float(pi.max())
     interior = float(np.sum(pi * trans.max(axis=1)))
     return (first + (sentence_len - 1) * interior + 1.0) / (sentence_len + 1)
@@ -215,6 +227,7 @@ def write_stackoverflow_nwp_fixture(
     max_sent: int = 64,
     test_clients: int = 10_000,
     alpha: float = 0.002,
+    clusters: int = 50,
 ) -> Path:
     """Write stackoverflow_{train,test}.h5 + stackoverflow.word_count in the
     real TFF schema (``examples/<client>/tokens`` string sentences;
@@ -241,13 +254,14 @@ def write_stackoverflow_nwp_fixture(
         "active_words": active_words, "sentence_len": sentence_len,
         "min_sent": min_sent, "max_sent": max_sent,
         "test_clients": test_clients, "alpha": alpha,
+        "clusters": clusters,
     }
     files = ["stackoverflow_train.h5", "stackoverflow_test.h5",
              "stackoverflow.word_count"]
     if not fixture_util.prepare(out, "stackoverflow_nwp", config, files):
         return out
     rng = np.random.RandomState(seed)
-    trans, pi = stackoverflow_markov_source(active_words, seed, alpha)
+    trans, pi = stackoverflow_markov_source(active_words, seed, alpha, clusters)
     cum = np.cumsum(trans, axis=1).astype(np.float32)
     words = np.asarray([f"w{k}" for k in range(vocab_size)], dtype=object)
 

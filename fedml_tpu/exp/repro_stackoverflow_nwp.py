@@ -67,7 +67,7 @@ def run(args) -> dict:
         write_stackoverflow_nwp_fixture(
             data_dir, n_clients=args.client_num_in_total, seed=args.seed,
             test_clients=args.test_clients, vocab_size=args.vocab_size,
-            active_words=active,
+            active_words=active, sentence_len=args.fixture_sentence_len,
         )
         logging.info("fixture ready in %.0fs", time.time() - t0)
 
@@ -126,13 +126,16 @@ def run(args) -> dict:
                   if k != "round"},
     }
     if not real:
+        sl = args.fixture_sentence_len
         bayes = stackoverflow_bayes_ceiling(
-            active_words=min(2000, args.vocab_size), seed=args.seed
+            active_words=min(2000, args.vocab_size), seed=args.seed,
+            sentence_len=sl,
         )
-        # eos-only floor: the writer's fixed sentence_len=10 makes the final
-        # eos deterministic, so a model that learned NOTHING but "predict
-        # eos" scores 1/11 — report the fraction of LEARNABLE signal
-        floor = 1.0 / 11.0
+        # eos-only floor: the fixture's fixed sentence length makes the
+        # final eos deterministic, so a model that learned NOTHING but
+        # "predict eos" scores 1/(sl+1) — report the fraction of LEARNABLE
+        # signal above that
+        floor = 1.0 / (sl + 1)
         result["fixture_bayes_ceiling"] = round(bayes, 4)
         result["eos_only_floor"] = round(floor, 4)
         result["pct_of_ceiling"] = round(100 * best / bayes, 1)
@@ -215,6 +218,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--lr", type=float, default=10 ** -0.5)
     parser.add_argument("--seq_len", type=int, default=20)
     parser.add_argument("--vocab_size", type=int, default=10_000)
+    parser.add_argument("--fixture_sentence_len", type=int, default=10,
+                        help="fixed words per fixture sentence (drives both "
+                             "the writer and the floor/ceiling math)")
     parser.add_argument("--embedding_dim", type=int, default=96)
     parser.add_argument("--hidden_size", type=int, default=670)
     parser.add_argument("--test_clients", type=int, default=10_000)
