@@ -23,6 +23,23 @@ def update_section(path: str | Path, name: str, content: str) -> None:
     p.write_text(text)
 
 
+def ceiling_lookup(label: str, store: str | Path = "repro_ceilings.json"):
+    """Row from the fixture-ceilings sidecar store (repro_ceilings.py), or
+    None. Lets each repro section emit its own ceiling cross-reference so
+    regeneration never wipes it."""
+    import json
+
+    p = Path(store)
+    if not p.exists():
+        return None
+    try:
+        data = json.loads(p.read_text())
+    except json.JSONDecodeError:
+        return None
+    row = data.get(label) if isinstance(data, dict) else None
+    return row if isinstance(row, dict) else None
+
+
 def acc_curve(evals: list, points: int = 12, key: str = "Test/Acc") -> str:
     """Downsampled ``round:acc%`` curve string for REPRO.md sections."""
     step = max(1, len(evals) // points)

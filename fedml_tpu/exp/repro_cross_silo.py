@@ -195,6 +195,9 @@ def run(args) -> dict:
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
+    args.cohort_execution = resolve_cohort_execution(
+        args.model, args.cohort_execution
+    )
     data_dir = Path(args.data_dir) if args.data_dir else Path(f"./data/{args.dataset}")
     # real = data exists in a layout the reader accepts and no fixture
     # marker claims it — existence only, the actual load happens once below
@@ -268,6 +271,7 @@ def run(args) -> dict:
         # tunnel twice; one round per dispatch is stable and costs nothing at
         # 105 s/round
         block_dispatch=False,
+        cohort_execution=args.cohort_execution,  # see resolve_cohort_execution
     )
     sim = FedSim(trainer, train, test, cfg, mesh=mesh)
 
@@ -335,6 +339,17 @@ def run(args) -> dict:
         _write_report(Path(args.out), args, result, evals, real)
     logging.info("cross-silo repro result: %s", result)
     return result
+
+
+def resolve_cohort_execution(model: str, explicit: str | None) -> str:
+    """Auto cohort mode: MobileNet's depthwise convolutions hit XLA's
+    grouped-convolution slow path when the cohort is vmapped (the weight
+    gradient becomes a batch_group_count conv — measured minutes/round on
+    chip), so it trains clients sequentially; dense-conv models keep the
+    vmapped cohort."""
+    if explicit is not None:
+        return explicit
+    return "scan" if model == "mobilenet" else "vmap"
 
 
 # published cross-silo table (benchmark/README.md:102-110): (IID, non-IID)
@@ -462,6 +477,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--comm_round", type=int, default=100)
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--cohort_execution", type=str, default=None,
+                        choices=("vmap", "scan"),
+                        help="None = auto: scan for mobilenet (vmapped "
+                             "depthwise convs are pathologically slow), "
+                             "vmap otherwise")
     parser.add_argument("--round_sleep", type=float, default=2.0,
                         help="idle gap between round dispatches (tunnel "
                              "stability; see run())")

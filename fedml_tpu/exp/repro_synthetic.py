@@ -62,12 +62,15 @@ def run(args) -> dict:
 
 
 def _write_report(path, args, results: dict) -> None:
-    from fedml_tpu.exp._report import update_section
+    from fedml_tpu.exp._report import ceiling_lookup, update_section
 
-    rows = "\n".join(
-        f"| {name} | {r['best_test_acc'] * 100:.1f} | {r['first_round_over_60']} |"
-        for name, r in results.items()
-    )
+    def _row(name, r):
+        ceil = ceiling_lookup(name)
+        base = f"{ceil['ceiling_acc'] * 100:.1f}" if ceil else "n/a"
+        return (f"| {name} | {r['best_test_acc'] * 100:.1f} | {base} "
+                f"| {r['first_round_over_60']} |")
+
+    rows = "\n".join(_row(name, r) for name, r in results.items())
     curves = "\n".join(
         f"- `{name}`: " + ", ".join(f"{rr}:{acc * 100:.1f}" for rr, acc in r["curve"])
         for name, r in results.items()
@@ -86,8 +89,8 @@ capped at 10,000 samples/client — none of this run's draws hit the cap,
 see clients_sizes_minmax in the JSON output). No fixture substitution was
 needed.
 
-| config | best test acc ({args.comm_round} rounds) | first round > 60 |
-|---|---|---|
+| config | best test acc ({args.comm_round} rounds) | centralized baseline (ceilings table) | first round > 60 |
+|---|---|---|---|
 {rows}
 
 Accuracy curves (round:acc, eval every {args.frequency_of_the_test} rounds):

@@ -112,3 +112,14 @@ def test_cross_silo_table_combos_end_to_end(tmp_path, dataset, model):
     assert np.isfinite(result["final_test_acc"])
     text = (tmp_path / "R.md").read_text()
     assert f"cross_silo_{dataset}_{model}_hetero" in text
+
+
+def test_cross_silo_cohort_execution_auto_selection():
+    """MobileNet defaults to the scan cohort (vmapped depthwise convs hit
+    XLA's grouped-convolution slow path — measured minutes/round on chip);
+    ResNet keeps vmap. Explicit --cohort_execution overrides both."""
+    from fedml_tpu.exp.repro_cross_silo import resolve_cohort_execution
+
+    assert resolve_cohort_execution("mobilenet", None) == "scan"
+    assert resolve_cohort_execution("resnet56", None) == "vmap"
+    assert resolve_cohort_execution("mobilenet", "vmap") == "vmap"
