@@ -148,11 +148,22 @@ class FedAvgServerManager(ServerManager):
         self.client_num_in_total = client_num_in_total or worker_num
         self.on_round_done = on_round_done
 
+    def _model_payload(self, rank: int):
+        """Model payload for ``rank`` — the wire-format seam. Base sends the
+        packed flat byte vector; the mobile server (fedavg_mobile.py) sends
+        the reference's nested-list JSON to its ``is_mobile`` ranks."""
+        return self.global_flat
+
+    def _decode_upload(self, msg: Message) -> np.ndarray:
+        """Inverse seam: a client upload back to the flat byte vector."""
+        return np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+
     def send_init_msg(self) -> None:
         cohort = rnglib.sample_clients(0, self.client_num_in_total, self.worker_num)
         for w in range(self.worker_num):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, 0, w + 1)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                           self._model_payload(w + 1))
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC, self.model_desc)
             msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
             self.send_message(msg)
@@ -166,7 +177,7 @@ class FedAvgServerManager(ServerManager):
         sender = msg.get_sender_id()
         from fedml_tpu.comm.status import ClientStatus
 
-        flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        flat = self._decode_upload(msg)
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
         upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         # staleness/exclusion checks and the tally are one critical section:
@@ -239,7 +250,8 @@ class FedAvgServerManager(ServerManager):
             # tell the excluded client to stop: it would otherwise keep
             # training models the server discards every round
             stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w)
-            stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                            self._model_payload(w))
             stop.add_params("finished", 1)
             self.send_message(stop)
         self._complete_round(expected_round)
@@ -261,7 +273,8 @@ class FedAvgServerManager(ServerManager):
             # graceful stop: notify clients then stop own loop (NOT MPI.Abort)
             for w in range(self.worker_num):
                 stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
-                stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+                stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                self._model_payload(w + 1))
                 stop.add_params("finished", 1)
                 self.send_message(stop)
             self.finish()
@@ -269,7 +282,8 @@ class FedAvgServerManager(ServerManager):
         cohort = rnglib.sample_clients(self.round_idx, self.client_num_in_total, self.worker_num)
         for w in self.aggregator.live_workers():
             sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
-            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, self.global_flat)
+            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                            self._model_payload(w + 1))
             sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
             self.send_message(sync)
 
@@ -296,15 +310,26 @@ class FedAvgClientManager(ClientManager):
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_sync)
 
-    def _on_sync(self, msg: Message) -> None:
-        if msg.get("finished"):
-            self.finish()
-            return
+    def _decode_model(self, msg: Message):
+        """Wire-format seam: a sync payload back to model variables. The
+        mobile client (fedavg_mobile.py) parses the reference's nested-list
+        JSON here instead."""
         flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         desc = msg.get(MyMessage.MSG_ARG_KEY_MODEL_DESC)
         if desc is not None:
             self._desc = desc
-        variables = unpack_pytree(flat, self._desc)
+        return unpack_pytree(flat, self._desc)
+
+    def _encode_model(self, new_vars):
+        """Inverse seam: trained variables to the upload payload."""
+        flat_out, _ = pack_pytree(jax.tree.map(np.asarray, new_vars))
+        return flat_out
+
+    def _on_sync(self, msg: Message) -> None:
+        if msg.get("finished"):
+            self.finish()
+            return
+        variables = self._decode_model(msg)
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         batches, weights = stack_cohort(
             self.train_data, np.asarray([client_idx]), self.batch_size,
@@ -315,9 +340,9 @@ class FedAvgClientManager(ClientManager):
             variables, batches, jax.random.key(self.rank * 100003 + self._round)
         )
         self._round += 1
-        flat_out, _ = pack_pytree(jax.tree.map(np.asarray, new_vars))
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, flat_out)
+        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       self._encode_model(new_vars))
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weights[0]))
         out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round - 1)
         self.send_message(out)
@@ -369,13 +394,18 @@ def run_distributed_fedavg(
     round_timeout: float | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
+    server_cls: type[FedAvgServerManager] = None,
+    server_kwargs: dict | None = None,
+    client_cls_for_rank: Callable[[int], type] | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
     (loopback queues, native shm rings, grpc localhost, ...). Clients run in
     threads — the single-host harness the reference lacked (SURVEY §4); the
     same managers drive separate processes when the transport spans them.
-    Returns the final global variables."""
+    ``server_cls``/``server_kwargs``/``client_cls_for_rank`` swap in
+    protocol variants (e.g. fedavg_mobile's JSON-wire managers) without
+    duplicating this harness. Returns the final global variables."""
     template, flat, desc = init_template(trainer, train_data.arrays, batch_size,
                                          seed, init_overrides=init_overrides)
 
@@ -386,14 +416,16 @@ def run_distributed_fedavg(
         if on_round_done is not None:
             on_round_done(r, unpack_pytree(f, desc))
 
-    server = FedAvgServerManager(
+    server = (server_cls or FedAvgServerManager)(
         make_comm(0), worker_num, round_num, flat, desc,
         client_num_in_total=train_data.num_clients,
         round_timeout=round_timeout,
         on_round_done=_done,
+        **(server_kwargs or {}),
     )
+    cls_for = client_cls_for_rank or (lambda r: FedAvgClientManager)
     clients = [
-        FedAvgClientManager(
+        cls_for(r)(
             make_comm(r), r, worker_num + 1, trainer,
             train_data, batch_size, template,
         )

@@ -1,0 +1,121 @@
+"""`is_mobile` federated rounds: phone-side clients speak the reference's
+nested-list JSON wire format.
+
+Reference: fedml_api/distributed/fedavg/ — with ``args.is_mobile == 1`` the
+server transforms every outgoing model through ``transform_tensor_to_list``
+and every incoming one through ``transform_list_to_tensor``
+(FedAvgServerManager.py:36,77; FedAVGAggregator.py:65), so an Android/iOS
+runtime holding "a dict of parameter-name -> nested float lists" can join
+rounds without torch on the device. Here the same contract rides this
+framework's typed message layer: for ranks declared mobile, the model
+payload is a JSON string of :func:`params_to_nested_lists` (models/
+export.py — byte-exact float32 round-trip through JSON), and everything
+else about the protocol (message types, elastic rounds, staleness checks,
+status tracking) is inherited unchanged from fedavg_distributed.
+
+``MobileFedAvgClientManager`` stands in for the phone: it consumes ONLY the
+JSON wire dict (never the packed byte vector), trains, and uploads JSON.
+``tests/test_comm.py::test_mobile_wire_clients_match_native`` proves a
+mixed native+mobile federation reproduces the all-native result exactly.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy as np
+
+import jax
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    FedAvgClientManager,
+    FedAvgServerManager,
+    MyMessage,
+    run_distributed_fedavg,
+)
+from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
+from fedml_tpu.models.export import (
+    nested_lists_to_params,
+    params_to_nested_lists,
+)
+
+
+def variables_to_wire(variables) -> str:
+    """Reference ``transform_tensor_to_list`` over the full variables
+    pytree, as a JSON string (the mobile app's message body)."""
+    return json.dumps(params_to_nested_lists(variables))
+
+
+def wire_to_variables(payload: str, template):
+    """Reference ``transform_list_to_tensor``: JSON wire dict back to
+    variables shaped like ``template``."""
+    return nested_lists_to_params(json.loads(payload), template)
+
+
+class MobileFedAvgServerManager(FedAvgServerManager):
+    """FedAvg server that speaks nested-list JSON to its ``mobile_ranks``
+    and the packed byte vector to everyone else (the reference's
+    ``is_mobile`` branches, FedAvgServerManager.py:36,77)."""
+
+    def __init__(self, *args, mobile_ranks=(), **kwargs):
+        super().__init__(*args, **kwargs)
+        self.mobile_ranks = set(mobile_ranks)
+        self._wire_cache: tuple[Any, str] | None = None
+
+    def _current_variables(self):
+        return unpack_pytree(np.asarray(self.global_flat), self.model_desc)
+
+    def _model_payload(self, rank: int):
+        if rank not in self.mobile_ranks:
+            return super()._model_payload(rank)
+        # encode once per global model, not once per mobile rank: the JSON
+        # text of a full model is megabytes; M ranks share one encoding
+        cached = self._wire_cache
+        if cached is not None and cached[0] is self.global_flat:
+            return cached[1]
+        payload = variables_to_wire(self._current_variables())
+        self._wire_cache = (self.global_flat, payload)
+        return payload
+
+    def _decode_upload(self, msg: Message) -> np.ndarray:
+        if msg.get_sender_id() in self.mobile_ranks:
+            # the shape template is derivable from the current global —
+            # no separate (driftable) template state needed
+            variables = wire_to_variables(
+                msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS),
+                self._current_variables(),
+            )
+            flat, _ = pack_pytree(jax.tree.map(np.asarray, variables))
+            return flat
+        return super()._decode_upload(msg)
+
+
+class MobileFedAvgClientManager(FedAvgClientManager):
+    """The phone-side participant: model state crosses the wire ONLY as the
+    reference's JSON dict; local training here stands in for the on-device
+    runtime (the wire contract is the interop surface)."""
+
+    def _decode_model(self, msg: Message):
+        return wire_to_variables(
+            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS), self.template
+        )
+
+    def _encode_model(self, new_vars) -> str:
+        return variables_to_wire(jax.tree.map(np.asarray, new_vars))
+
+
+def run_distributed_fedavg_mobile(*args, mobile_ranks=(), **kwargs):
+    """:func:`run_distributed_fedavg` with ``mobile_ranks`` speaking the
+    JSON wire format — all base-runner features (elastic ``round_timeout``,
+    ``init_overrides`` warm-start, ...) pass through."""
+    mobile = set(mobile_ranks)
+    return run_distributed_fedavg(
+        *args,
+        server_cls=MobileFedAvgServerManager,
+        server_kwargs={"mobile_ranks": mobile},
+        client_cls_for_rank=lambda r: (
+            MobileFedAvgClientManager if r in mobile else FedAvgClientManager
+        ),
+        **kwargs,
+    )

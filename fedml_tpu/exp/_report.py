@@ -23,14 +23,20 @@ def update_section(path: str | Path, name: str, content: str) -> None:
     p.write_text(text)
 
 
-def ceiling_lookup(label: str, store: str | Path = "repro_ceilings.json"):
+def ceiling_lookup(label: str, report_path: str | Path | None = None,
+                   store: str | Path = "repro_ceilings.json"):
     """Row from the fixture-ceilings sidecar store (repro_ceilings.py), or
     None. Lets each repro section emit its own ceiling cross-reference so
-    regeneration never wipes it."""
+    regeneration never wipes it. The store is looked up next to the report
+    being written first (REPRO.md and repro_ceilings.json live together at
+    the repo root), then relative to the cwd."""
     import json
 
-    p = Path(store)
-    if not p.exists():
+    candidates = [Path(store)]
+    if report_path is not None:
+        candidates.insert(0, Path(report_path).resolve().parent / Path(store).name)
+    p = next((c for c in candidates if c.exists()), None)
+    if p is None:
         return None
     try:
         data = json.loads(p.read_text())

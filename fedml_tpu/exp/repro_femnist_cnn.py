@@ -107,7 +107,7 @@ def run(args) -> dict:
 def _write_report(path: Path, args, result: dict, evals: list) -> None:
     from fedml_tpu.exp._report import acc_curve, ceiling_lookup, update_section
 
-    ceil = ceiling_lookup("femnist_cnn")
+    ceil = ceiling_lookup("femnist_cnn", report_path=path)
     ceiling_line = (
         f"\n- fixture centralized ceiling {ceil['ceiling_acc'] * 100:.2f} "
         "(Fixture ceilings section): the row saturates its 10-class "

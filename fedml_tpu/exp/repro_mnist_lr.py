@@ -104,7 +104,7 @@ def run(args) -> dict:
 def _write_report(path: Path, args, result: dict, evals: list) -> None:
     from fedml_tpu.exp._report import ceiling_lookup, update_section
 
-    ceil = ceiling_lookup("mnist_lr")
+    ceil = ceiling_lookup("mnist_lr", report_path=path)
     ceiling_line = (
         f"\n- fixture centralized ceiling {ceil['ceiling_acc'] * 100:.2f} "
         "(Fixture ceilings section) -> federated best is "

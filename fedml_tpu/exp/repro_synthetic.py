@@ -65,7 +65,7 @@ def _write_report(path, args, results: dict) -> None:
     from fedml_tpu.exp._report import ceiling_lookup, update_section
 
     def _row(name, r):
-        ceil = ceiling_lookup(name)
+        ceil = ceiling_lookup(name, report_path=path)
         base = f"{ceil['ceiling_acc'] * 100:.1f}" if ceil else "n/a"
         return (f"| {name} | {r['best_test_acc'] * 100:.1f} | {base} "
                 f"| {r['first_round_over_60']} |")

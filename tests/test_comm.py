@@ -218,6 +218,65 @@ def test_grpc_backend_roundtrip():
     assert got == [(9, (4, 4))]
 
 
+def test_mobile_wire_clients_match_native():
+    """`is_mobile` interop (reference FedAvgServerManager.py:36,77): a
+    federation where some clients speak ONLY the nested-list JSON wire
+    format must reproduce the all-native result EXACTLY — float32 survives
+    tolist()/json round-trips bit-exactly — and the mobile rank's payloads
+    on the wire must be reference-shaped JSON."""
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg
+    from fedml_tpu.algorithms.fedavg_mobile import run_distributed_fedavg_mobile
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=3, samples_per_client=20, seed=5)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4), optimizer=optax.sgd(0.2),
+        epochs=1,
+    )
+
+    fabric_native = LoopbackFabric(4)
+    native = run_distributed_fedavg(
+        trainer, train, worker_num=3, round_num=2, batch_size=10,
+        make_comm=lambda r: LoopbackCommManager(fabric_native, r),
+    )
+
+    wire_payloads = []
+
+    class _SpyComm(LoopbackCommManager):
+        def send_message(self, msg):
+            if (msg.get_sender_id() == 3
+                    and msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS) is not None):
+                wire_payloads.append(msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS))
+            super().send_message(msg)
+
+    fabric_mixed = LoopbackFabric(4)
+    mixed = run_distributed_fedavg_mobile(
+        trainer, train, worker_num=3, round_num=2, batch_size=10,
+        make_comm=lambda r: (_SpyComm(fabric_mixed, r) if r == 3
+                             else LoopbackCommManager(fabric_mixed, r)),
+        mobile_ranks={3},
+    )
+
+    # bit-exact: the JSON leg must not perturb a single float
+    for a, b_ in zip(jax.tree_util.tree_leaves(native),
+                     jax.tree_util.tree_leaves(mixed)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+    # and what rank 3 actually sent is the reference's JSON dict of
+    # nested lists (name -> list-of-lists at the array's nesting depth)
+    import json as _json
+
+    assert wire_payloads, "mobile rank sent no model payloads"
+    for p in wire_payloads:
+        assert isinstance(p, str)
+        obj = _json.loads(p)
+        assert isinstance(obj, dict) and obj
+        assert all(isinstance(v, list) for v in obj.values())
+
+
 def test_distributed_fedavg_loopback_end_to_end():
     """Full protocol over loopback; with full participation + full batch +
     E=1 it must match the vectorized engine exactly (same math, different
