@@ -445,6 +445,7 @@ def run_distributed_fedavg_loopback(
     seed: int = 0,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
+    **runner_kwargs,
 ):
     """Distributed FedAvg on the in-process loopback fabric."""
     from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
@@ -454,6 +455,7 @@ def run_distributed_fedavg_loopback(
         trainer, train_data, worker_num, round_num, batch_size,
         lambda r: LoopbackCommManager(fabric, r), seed=seed,
         on_round_done=on_round_done, init_overrides=init_overrides,
+        **runner_kwargs,
     )
 
 
@@ -467,6 +469,7 @@ def run_distributed_fedavg_shm(
     job: str | None = None,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
+    **runner_kwargs,
 ):
     """Distributed FedAvg over the native shared-memory rings (the MPI-role
     single-host transport, comm/shm.py + ops/native/shm_ring.cpp)."""
@@ -482,7 +485,7 @@ def run_distributed_fedavg_shm(
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
             lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
-            init_overrides=init_overrides,
+            init_overrides=init_overrides, **runner_kwargs,
         )
     finally:
         for m in mgrs.values():
@@ -499,6 +502,7 @@ def run_distributed_fedavg_grpc(
     base_port: int = 29500,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
+    **runner_kwargs,
 ):
     """Distributed FedAvg over localhost gRPC (cross-host transport run
     single-host; an ip_config table generalizes it to a cluster, reference
@@ -513,7 +517,7 @@ def run_distributed_fedavg_grpc(
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
             lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
-            init_overrides=init_overrides,
+            init_overrides=init_overrides, **runner_kwargs,
         )
     finally:
         for m in mgrs.values():
@@ -534,6 +538,7 @@ def run_distributed_fedavg_mqtt_s3(
     threshold_bytes: int = 1 << 14,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
+    **runner_kwargs,
 ):
     """Distributed FedAvg over the production WAN combination: control
     messages on MQTT topics, model payloads through an object store keyed by
@@ -575,7 +580,7 @@ def run_distributed_fedavg_mqtt_s3(
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,
             lambda r: mgrs[r], seed=seed, on_round_done=on_round_done,
-            init_overrides=init_overrides,
+            init_overrides=init_overrides, **runner_kwargs,
         )
     finally:
         for m in mgrs.values():

@@ -105,17 +105,25 @@ class MobileFedAvgClientManager(FedAvgClientManager):
         return variables_to_wire(jax.tree.map(np.asarray, new_vars))
 
 
+def mobile_runner_kwargs(mobile_ranks) -> dict:
+    """The manager wiring that makes ``run_distributed_fedavg`` (or any of
+    its per-backend wrappers) speak JSON to ``mobile_ranks`` — one
+    definition shared by :func:`run_distributed_fedavg_mobile` and the
+    ``--is_mobile`` CLI path."""
+    mobile = set(mobile_ranks)
+    return {
+        "server_cls": MobileFedAvgServerManager,
+        "server_kwargs": {"mobile_ranks": mobile},
+        "client_cls_for_rank": lambda r: (
+            MobileFedAvgClientManager if r in mobile else FedAvgClientManager
+        ),
+    }
+
+
 def run_distributed_fedavg_mobile(*args, mobile_ranks=(), **kwargs):
     """:func:`run_distributed_fedavg` with ``mobile_ranks`` speaking the
     JSON wire format — all base-runner features (elastic ``round_timeout``,
     ``init_overrides`` warm-start, ...) pass through."""
-    mobile = set(mobile_ranks)
     return run_distributed_fedavg(
-        *args,
-        server_cls=MobileFedAvgServerManager,
-        server_kwargs={"mobile_ranks": mobile},
-        client_cls_for_rank=lambda r: (
-            MobileFedAvgClientManager if r in mobile else FedAvgClientManager
-        ),
-        **kwargs,
+        *args, **mobile_runner_kwargs(mobile_ranks), **kwargs
     )

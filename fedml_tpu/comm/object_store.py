@@ -103,6 +103,10 @@ class S3Store(ObjectStore):
 
 
 _OFFLOADED = "__offloaded__"  # header key: {param_key: store_key, ...}
+# large TEXT payloads (e.g. the is_mobile nested-list JSON wire) ride the
+# store too — raw utf-8 blobs under their own header so the receive side
+# restores a str, not an array
+_OFFLOADED_TEXT = "__offloaded_text__"
 
 
 class OffloadCommManager(BaseCommunicationManager):
@@ -129,6 +133,7 @@ class OffloadCommManager(BaseCommunicationManager):
         # can be reused for further receivers (each send uploads fresh blobs,
         # which matters with cleanup=True — the first receiver deletes them).
         offloaded: dict[str, str] = {}
+        offloaded_text: dict[str, str] = {}
         out = Message()
         out.msg_params = dict(msg.msg_params)
         for k, v in list(out.msg_params.items()):
@@ -137,23 +142,33 @@ class OffloadCommManager(BaseCommunicationManager):
                 self.store.put(key, _array_bytes(v))
                 offloaded[k] = key
                 del out.msg_params[k]
+            elif isinstance(v, str) and len(v) >= self.threshold:
+                key = f"{k}-{uuid.uuid4().hex}"
+                self.store.put(key, v.encode("utf-8"))
+                offloaded_text[k] = key
+                del out.msg_params[k]
         if offloaded:
             out.add_params(_OFFLOADED, offloaded)
+        if offloaded_text:
+            out.add_params(_OFFLOADED_TEXT, offloaded_text)
         self.inner.send_message(out)
 
     # -- receive path -------------------------------------------------------
 
     def _resolve(self, msg: Message) -> Message:
-        offloaded = msg.get(_OFFLOADED)
-        if offloaded:
-            for param_key, store_key in offloaded.items():
-                msg.add_params(param_key, _bytes_array(self.store.get(store_key)))
+        for header, restore in ((_OFFLOADED, _bytes_array),
+                                (_OFFLOADED_TEXT, lambda b: b.decode("utf-8"))):
+            table = msg.get(header)
+            if not table:
+                continue
+            for param_key, store_key in table.items():
+                msg.add_params(param_key, restore(self.store.get(store_key)))
                 if self.cleanup:
                     try:
                         self.store.delete(store_key)
                     except OSError:
                         pass
-            del msg.msg_params[_OFFLOADED]
+            del msg.msg_params[header]
         return msg
 
     def handle_receive_message(self) -> None:

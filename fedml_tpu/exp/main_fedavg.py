@@ -49,7 +49,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--frequency_of_the_test", type=int, default=5)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--ci", type=int, default=0)
-    parser.add_argument("--is_mobile", type=int, default=0)  # parity no-op: payloads are arrays
+    parser.add_argument("--is_mobile", type=int, default=0,
+                        help="1 = clients speak the reference's nested-list "
+                             "JSON wire format (transform_tensor_to_list, "
+                             "fedavg/utils.py:7-16) over any --backend; "
+                             "requires a message-passing backend")
     parser.add_argument("--backend", type=str, default="sim",
                         choices=["sim", "loopback", "shm", "grpc", "mqtt_s3"],
                         help="sim = vectorized single-program engine; "
@@ -264,6 +268,16 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
 
         overrides = load_params(args.init_from)
         logging.info("warm-starting from %s", args.init_from)
+    mobile_kwargs = {}
+    if getattr(args, "is_mobile", 0):
+        # reference semantics: is_mobile=1 means EVERY client is a phone —
+        # all model payloads cross the wire as nested-list JSON
+        from fedml_tpu.algorithms.fedavg_mobile import mobile_runner_kwargs
+
+        ranks = set(range(1, cfg.client_num_per_round + 1))
+        mobile_kwargs = mobile_runner_kwargs(ranks)
+        logging.info("is_mobile=1: JSON nested-list wire format for ranks %s",
+                     sorted(ranks))
     final_variables = runners[args.backend](
         trainer, ds.train,
         worker_num=cfg.client_num_per_round,
@@ -272,6 +286,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         seed=cfg.seed,
         on_round_done=on_round,
         init_overrides=overrides,
+        **mobile_kwargs,
     )
     if getattr(args, "save_params_to", None):
         from fedml_tpu.obs.checkpoint import save_params
@@ -290,6 +305,13 @@ def run(args) -> list[dict]:
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
+    if getattr(args, "is_mobile", 0) and args.backend == "sim":
+        # pure flag-combination error: fail before any data/model work
+        raise NotImplementedError(
+            "--is_mobile 1 selects the JSON wire format, which only exists "
+            "on the message-passing backends — pick --backend "
+            "loopback|shm|grpc|mqtt_s3"
+        )
     logging.info("devices: %s", jax.devices())
 
     ds = load_partition_data(

@@ -307,6 +307,45 @@ def test_distributed_fedavg_loopback_end_to_end():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-4, atol=2e-5)
 
 
+def test_object_store_offloads_large_text(tmp_path):
+    """Large STRING payloads (the is_mobile nested-list JSON wire) ride the
+    object store like arrays do — a real MQTT broker caps inline payloads,
+    so megabytes of JSON on the control topic would reject/hang rounds."""
+    from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+    fabric = LoopbackFabric(2)
+    store = FileSystemStore(tmp_path / "store")
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+            mgr1.stop_receive_message()
+
+    inner1 = LoopbackCommManager(fabric, 1)
+    mgr1 = OffloadCommManager(inner1, store, threshold_bytes=256)
+    mgr1.add_observer(Obs())
+    mgr0 = OffloadCommManager(LoopbackCommManager(fabric, 0), store,
+                              threshold_bytes=256)
+
+    big_json = "[" + ",".join("0.125" for _ in range(200)) + "]"
+    msg = Message(5, 0, 1)
+    msg.add_params("model_params", big_json)
+    msg.add_params("note", "tiny")  # under threshold: stays inline
+    # the inline wire copy must NOT carry the big text
+    sent = []
+    orig = mgr0.inner.send_message
+    mgr0.inner.send_message = lambda m: (sent.append(m), orig(m))[1]
+    mgr0.send_message(msg)
+    mgr1.handle_receive_message()
+
+    assert sent[0].get("model_params") is None
+    assert got[0].get("model_params") == big_json
+    assert isinstance(got[0].get("model_params"), str)
+    assert got[0].get("note") == "tiny"
+    assert "__offloaded_text__" not in got[0].msg_params
+
+
 def test_object_store_offload_roundtrip(tmp_path):
     """Large arrays ride the object store; small params stay inline
     (MQTT_S3 pattern, mqtt_s3_multi_clients_comm_manager.py:178-249)."""

@@ -99,6 +99,46 @@ def test_cli_backend_message_passing(backend, tmp_path):
     assert final["Test/Acc"] > 0.5
 
 
+def test_cli_is_mobile_json_wire(tmp_path, monkeypatch):
+    """--is_mobile 1 runs the message-passing round with every client on
+    the reference's nested-list JSON wire format; on --backend sim it must
+    fail loudly (there is no wire to format). The spy proves the mobile
+    managers actually carried the round — a silent fall-back to the native
+    byte-vector wire would converge identically and hide a regression."""
+    from fedml_tpu.algorithms import fedavg_mobile
+    from fedml_tpu.exp.main_fedavg import main
+
+    seen_mobile_ranks = []
+    orig_init = fedavg_mobile.MobileFedAvgServerManager.__init__
+
+    def spy(self, *a, mobile_ranks=(), **kw):
+        seen_mobile_ranks.append(set(mobile_ranks))
+        orig_init(self, *a, mobile_ranks=mobile_ranks, **kw)
+
+    monkeypatch.setattr(
+        fedavg_mobile.MobileFedAvgServerManager, "__init__", spy
+    )
+
+    final = main([
+        "--dataset", "synthetic", "--model", "lr", "--backend", "loopback",
+        "--is_mobile", "1",
+        "--client_num_in_total", "4", "--client_num_per_round", "4",
+        "--batch_size", "8", "--comm_round", "3", "--epochs", "1",
+        "--frequency_of_the_test", "3", "--run_dir", str(tmp_path),
+    ])
+    assert final["round"] == 2
+    assert final["Test/Acc"] > 0.5
+    assert seen_mobile_ranks == [{1, 2, 3, 4}]
+
+    with pytest.raises(NotImplementedError, match="is_mobile"):
+        main([
+            "--dataset", "synthetic", "--model", "lr", "--backend", "sim",
+            "--is_mobile", "1", "--client_num_in_total", "4",
+            "--client_num_per_round", "4", "--batch_size", "8",
+            "--comm_round", "1", "--run_dir", str(tmp_path),
+        ])
+
+
 def test_model_dtype_flag():
     import jax.numpy as jnp
     import pytest
