@@ -109,6 +109,24 @@ def test_gossip_learns_and_contracts_consensus():
     assert hist[-1]["consensus_dist"] < hist[1]["consensus_dist"]
 
 
+def test_gossip_scan_cohort_matches_vmap():
+    """cohort_execution='scan' must be bit-compatible with vmap in
+    PER-CLIENT mode too — the scan branch maps over the stacked per-client
+    variables alongside the batches (the less-traveled lax.map pytree
+    path)."""
+    sim_v, train, tr, cfg = _setup(rounds=3)
+    vars_v, _ = sim_v.run()
+    sim_s = FedSim(
+        tr, train, None,
+        dataclasses.replace(cfg, cohort_execution="scan"),
+        aggregator=gossip_aggregator(ring_topology(8)),
+    )
+    vars_s, _ = sim_s.run()
+    for a, b in zip(jax.tree.leaves(vars_v), jax.tree.leaves(vars_s)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+
+
 def test_per_client_requires_full_participation():
     train, test = gaussian_blobs(
         n_clients=4, samples_per_client=8, num_classes=4, dim=8, seed=0
