@@ -30,10 +30,86 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+import threading
 import time
 from pathlib import Path
 
 CACHE = Path(__file__).parent / ".bench_cache.json"
+
+# Backend-init robustness: on this tunneled chip the first jax.devices() call
+# can hang indefinitely when the tunnel is down (round 4: BENCH_r04 rc=1 with
+# a raw traceback, MULTICHIP_r04 rc=124). The default backend is probed in a
+# SUBPROCESS under a timeout (a hung in-process probe thread would hold jax's
+# backend-init lock and poison any fallback), retried with backoff; if the
+# chip never answers, the bench falls back to XLA:CPU — jax-vs-torch on the
+# same host CPU is still a meaningful vs_baseline — and records the fallback
+# reason in extra. Worst case, a machine-readable error JSON line is printed
+# instead of a stack trace so the driver artifact is diagnosable, not null.
+BACKEND_TIMEOUT_S = float(os.environ.get("FEDML_TPU_BENCH_BACKEND_TIMEOUT", 150))
+BACKEND_RETRIES = int(os.environ.get("FEDML_TPU_BENCH_BACKEND_RETRIES", 2))
+
+
+class BackendUnavailable(RuntimeError):
+    pass
+
+
+def _probe_backend() -> tuple[str, str | None]:
+    """Initialize a JAX backend; return (device_kind, fallback_reason).
+
+    The default (tunneled TPU) platform is probed in a subprocess with a
+    timeout. Only if the probe answers is jax initialized in-process (still
+    thread-guarded — the tunnel can flake between probe and init). If the
+    probe never answers, JAX_PLATFORMS=cpu is forced BEFORE the in-process
+    import so the hung plugin is never touched, and the reason is returned.
+    """
+    import subprocess
+
+    probe_src = "import jax; d = jax.devices()[0]; print('OK', d.device_kind)"
+    reason = None
+    for attempt in range(BACKEND_RETRIES + 1):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", probe_src],
+                capture_output=True, text=True, timeout=BACKEND_TIMEOUT_S,
+            )
+        except subprocess.TimeoutExpired:
+            reason = f"backend probe exceeded {BACKEND_TIMEOUT_S:.0f}s"
+        else:
+            if out.returncode == 0 and out.stdout.startswith("OK "):
+                break
+            tail = (out.stderr or out.stdout).strip().splitlines()
+            reason = tail[-1] if tail else f"probe rc={out.returncode}"
+        if attempt < BACKEND_RETRIES:
+            time.sleep(10.0 * (attempt + 1))
+    else:
+        # chip never answered: force CPU before jax is first imported here
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.extend.backend as jeb
+
+        jeb.clear_backends()
+        return jax.devices()[0].device_kind, f"tpu unavailable: {reason}"
+
+    # probe answered — init in-process, still guarded against a flake
+    box: dict = {}
+
+    def init():
+        import jax
+
+        box["kind"] = jax.devices()[0].device_kind
+
+    t = threading.Thread(target=init, daemon=True)
+    t.start()
+    t.join(BACKEND_TIMEOUT_S)
+    if "kind" not in box:
+        raise BackendUnavailable(
+            "backend probe succeeded but in-process init hung "
+            f"past {BACKEND_TIMEOUT_S:.0f}s"
+        )
+    return box["kind"], None
 
 CLIENTS = 10
 STEPS = 8
@@ -140,8 +216,13 @@ def _measure_rounds(sim, n_meas: int = 5, block: int = 1) -> float:
     return (time.perf_counter() - t0) / (n_meas * block)
 
 
-def bench_resnet():
-    """(rounds/sec, eval examples/sec) for the primary ResNet-56 config."""
+def bench_resnet(reduced: bool = False):
+    """(rounds/sec, eval examples/sec) for the primary ResNet-56 config.
+
+    ``reduced`` (the XLA:CPU fallback) keeps the model and the primary
+    block-dispatch metric but drops the f32/single-dispatch secondaries and
+    shrinks eval — each extra sim variant costs ~100 s of XLA:CPU ResNet-56
+    compilation, which is what timed out the fallback's first draft."""
     import numpy as np
 
     import optax
@@ -169,7 +250,7 @@ def bench_resnet():
         batch_size=BATCH, comm_round=1, epochs=EPOCHS,
         frequency_of_the_test=10_000, shuffle_each_round=False, seed=0,
     )
-    n_eval = 4096
+    n_eval = 512 if reduced else 4096
     test = {
         "x": rng.rand(n_eval, 32, 32, 3).astype(np.float32),
         "y": rng.randint(0, 10, n_eval).astype(np.int32),
@@ -183,6 +264,19 @@ def bench_resnet():
         optimizer=optax.sgd(0.1, momentum=0.9),
         epochs=EPOCHS,
     )
+    if reduced:
+        # f32 on the CPU fallback: bf16 matmuls are software-emulated on
+        # XLA:CPU, which would benchmark the emulation, not the engine
+        sec_per_round = _measure_rounds(
+            FedSim(trainer, train, test, cfg), n_meas=1, block=2
+        )
+        sim = FedSim(trainer, train, test, cfg)
+        variables = sim.init_round_variables()
+        sim.evaluate(variables)  # compile
+        t0 = time.perf_counter()
+        sim.evaluate(variables)
+        eval_eps = (n + n_eval) / (time.perf_counter() - t0)
+        return 1.0 / sec_per_round, None, None, eval_eps, eval_eps
     sec_per_round = _measure_rounds(
         FedSim(trainer_bf16, train, test, cfg), n_meas=3, block=10
     )
@@ -198,23 +292,26 @@ def bench_resnet():
 
     # pooled eval throughput (examples/sec): evaluate() runs the pooled train
     # set (n) plus the test set (n_eval) and returns host floats, so it is
-    # synchronous by construction. Measured as best-of-3 trials after a
-    # warm-up: on this tunneled chip, eval throughput ramps with recent
-    # dispatch activity (measured 14k ex/s cold vs 19.7k after sustained
-    # work — the BENCH_r02 -> r03 'regression' was exactly this warm-up
-    # state, not an engine change), so steady-state is the honest number.
+    # synchronous by construction. Measured over 3 trials after a warm-up:
+    # on this tunneled chip, eval throughput ramps with recent dispatch
+    # activity (measured 14k ex/s cold vs 19.7k after sustained work — the
+    # BENCH_r02 -> r03 'regression' was exactly this warm-up state, not an
+    # engine change). The PRIMARY figure is the median trial (steady state,
+    # comparable across rounds); the best trial stays in extra so the
+    # warm-up rationale remains auditable (BENCH_r03 reported best-of).
     variables = sim.init_round_variables()
     sim.evaluate(variables)  # compile
     for _ in range(2):
         sim.evaluate(variables)  # ramp
-    eval_eps = 0.0
+    trials = []
     for _trial in range(3):
         t0 = time.perf_counter()
         for _ in range(3):
             sim.evaluate(variables)
-        eval_eps = max(eval_eps, (n + n_eval) * 3 / (time.perf_counter() - t0))
+        trials.append((n + n_eval) * 3 / (time.perf_counter() - t0))
+    eval_eps = sorted(trials)[len(trials) // 2]
     return (1.0 / sec_per_round, 1.0 / sec_per_round_single,
-            1.0 / sec_per_round_f32, eval_eps)
+            1.0 / sec_per_round_f32, eval_eps, max(trials))
 
 
 def bench_conv_probe():
@@ -359,6 +456,43 @@ def bench_torch_reference() -> float:
 
 
 def main():
+    stage_box = ["torch_baseline"]
+    try:
+        _main(stage_box)
+    except BaseException as e:  # noqa: BLE001 — the artifact must be JSON
+        print(json.dumps({
+            "metric": "bench_error",
+            "value": None,
+            "unit": "rounds/sec",
+            "vs_baseline": None,
+            "error": f"{type(e).__name__}: {e}",
+            "stage": stage_box[0],
+        }))
+        sys.exit(1)
+
+
+def _main(stage: list):
+    global CLIENTS, STEPS, BATCH
+
+    stage[0] = "backend_init"
+    device_kind, fallback_reason = _probe_backend()
+    # persistent XLA compile cache (same location as the test suite's):
+    # repeated driver runs skip recompilation of the round programs
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("FEDML_TPU_JAX_CACHE",
+                                     "/tmp/fedml_tpu_jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    peak = PEAK_TFLOPS.get(device_kind)
+    if fallback_reason is not None:
+        # XLA:CPU fallback: shrink the federated shape so the bench finishes
+        # in minutes, and skip the MFU probes (peak-relative numbers are
+        # chip-only). The torch baseline below is re-measured at the SAME
+        # reduced shape, so vs_baseline remains apples-to-apples.
+        CLIENTS, STEPS, BATCH = 2, 2, 8
+
+    stage[0] = "torch_baseline"
     cache = {}
     if CACHE.exists():
         try:
@@ -374,30 +508,45 @@ def main():
             pass
     baseline = cache[key]
 
-    import jax
-
-    device_kind = jax.devices()[0].device_kind
-    peak = PEAK_TFLOPS.get(device_kind)
-
-    rounds_per_sec, rounds_per_sec_single, rounds_per_sec_f32, eval_eps = bench_resnet()
+    stage[0] = "bench_resnet"
+    rounds_per_sec, rounds_per_sec_single, rounds_per_sec_f32, eval_eps, eval_eps_best = bench_resnet(
+        reduced=fallback_reason is not None
+    )
     resnet_tflops = (
         resnet56_train_flops_per_image() * CLIENTS * STEPS * BATCH * EPOCHS
         * rounds_per_sec / 1e12
     )
-    conv_tflops = bench_conv_probe()
+    if fallback_reason is None:
+        stage[0] = "bench_conv_probe"
+        conv_tflops = bench_conv_probe()
 
-    lm_sec = bench_lm()
-    lm_tflops = lm_train_flops_per_round() / lm_sec / 1e12
-    mfu = (lm_tflops / peak) if peak else None
+        stage[0] = "bench_lm"
+        lm_sec = bench_lm()
+        lm_tflops = lm_train_flops_per_round() / lm_sec / 1e12
+        mfu = (lm_tflops / peak) if peak else None
+    else:
+        conv_tflops = lm_sec = lm_tflops = mfu = None
+
+    def rnd(x, n):
+        return round(x, n) if x is not None else None
 
     print(json.dumps({
-        "metric": "fedavg_rounds_per_sec_resnet56_cifar10_10clients_bf16",
+        # the metric KEY changes on fallback: the reduced f32 CPU figure
+        # must never be compared against prior 10-client bf16 TPU values
+        # by a consumer that only joins on the metric name
+        "metric": ("fedavg_rounds_per_sec_resnet56_cifar10_2clients_f32_cpufallback"
+                   if fallback_reason is not None
+                   else "fedavg_rounds_per_sec_resnet56_cifar10_10clients_bf16"),
         "value": round(rounds_per_sec, 4),
         "unit": "rounds/sec",
         "vs_baseline": round(rounds_per_sec / baseline, 2),
-        "mfu": round(mfu, 4) if mfu is not None else None,
+        "mfu": rnd(mfu, 4),
         "extra": {
             "device": device_kind,
+            "platform_fallback": fallback_reason,
+            "bench_shape": f"{CLIENTS} clients x {STEPS} steps x batch {BATCH}"
+            + (" [reduced f32 CPU-fallback shape: bf16 is emulated on "
+               "XLA:CPU]" if fallback_reason else ""),
             "peak_bf16_tflops": peak,
             "lm_config": (
                 f"TransformerLM bf16 D{LM_D} L{LM_L} H{LM_H} T{LM_T} V{LM_V}, "
@@ -406,8 +555,8 @@ def main():
                 f"cohort={LM_COHORT} (sequential clients free the HBM that "
                 "capped round 3 at batch 4 / MFU 0.467)"
             ),
-            "lm_sec_per_round": round(lm_sec, 4),
-            "lm_delivered_tflops": round(lm_tflops, 2),
+            "lm_sec_per_round": rnd(lm_sec, 4),
+            "lm_delivered_tflops": rnd(lm_tflops, 2),
             "resnet_delivered_tflops": round(resnet_tflops, 2),
             "resnet_bound": (
                 "arithmetic-intensity, not engine overhead: ResNet-56 CIFAR "
@@ -423,13 +572,15 @@ def main():
                 f"{CP_LAYERS}x conv3x3 {CP_C}ch bf16 @ {CP_HW}x{CP_HW}, "
                 f"{CP_CLIENTS} clients x {CP_STEPS} steps x batch {CP_BATCH}"
             ),
-            "conv_probe_delivered_tflops": round(conv_tflops, 2),
+            "conv_probe_delivered_tflops": rnd(conv_tflops, 2),
             "conv_probe_pct_peak": (
-                round(100 * conv_tflops / peak, 1) if peak else None
+                round(100 * conv_tflops / peak, 1)
+                if (peak and conv_tflops is not None) else None
             ),
-            "resnet_rounds_per_sec_single_dispatch": round(rounds_per_sec_single, 3),
-            "resnet_f32_rounds_per_sec": round(rounds_per_sec_f32, 3),
+            "resnet_rounds_per_sec_single_dispatch": rnd(rounds_per_sec_single, 3),
+            "resnet_f32_rounds_per_sec": rnd(rounds_per_sec_f32, 3),
             "eval_examples_per_sec": round(eval_eps, 1),
+            "eval_examples_per_sec_best": round(eval_eps_best, 1),
         },
     }))
 

@@ -150,3 +150,55 @@ class FedGKT:
 
         server_logits = jax.vmap(feedback)(feats)
         return new_svars, server_logits
+
+
+def run_fedgkt(
+    gkt: FedGKT,
+    client_batches: list[dict],
+    rounds: int,
+    client_epochs: int,
+    server_epochs: int,
+    rng: jax.Array,
+):
+    """In-process GKT orchestration (GKTServerManager round loop role):
+    every client trains locally against last round's server logits (zeros in
+    round 0), the server trains on the concatenated feature/logit/label
+    stacks in client order, and its per-batch logits flow back split per
+    client. ``client_batches[i]`` is client i's [S, B, ...] stack.
+
+    Also the numerics oracle for the comm-layer path (fedgkt_dist.py): the
+    distributed run calls the SAME two jitted phase programs with the same
+    key schedule, so it is bit-identical to this loop."""
+    import numpy as np
+
+    sample_x = client_batches[0]["x"][0]
+    cvars0, svars = gkt.init(rng, sample_x)
+    cvars = [jax.tree.map(jnp.copy, cvars0) for _ in client_batches]
+    _, logits0 = gkt.client_module.apply(cvars0, sample_x, train=False)
+    n_classes = logits0.shape[-1]
+    server_logits = [
+        jnp.zeros(tuple(np.shape(b["y"])) + (n_classes,)) for b in client_batches
+    ]
+    client_train = jax.jit(gkt.client_train, static_argnums=3)
+    server_train = jax.jit(gkt.server_train, static_argnums=5)
+
+    for _ in range(rounds):
+        feats_l, clog_l = [], []
+        for ci, batches in enumerate(client_batches):
+            rng, sub = jax.random.split(rng)
+            cvars[ci], f, cl = client_train(
+                cvars[ci], batches, server_logits[ci], client_epochs, sub
+            )
+            feats_l.append(f)
+            clog_l.append(cl)
+        feats = jnp.concatenate(feats_l, 0)
+        clog = jnp.concatenate(clog_l, 0)
+        ys = jnp.concatenate([b["y"] for b in client_batches], 0)
+        ms = jnp.concatenate([b["mask"] for b in client_batches], 0)
+        svars, slog = server_train(svars, feats, clog, ys, ms, server_epochs)
+        off = 0
+        for ci, b in enumerate(client_batches):
+            s = int(np.shape(b["y"])[0])
+            server_logits[ci] = slog[off:off + s]
+            off += s
+    return cvars, svars, server_logits

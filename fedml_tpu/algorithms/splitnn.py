@@ -6,10 +6,12 @@ computes loss, backprops and returns ``acts.grad`` (server.py:40-60); clients
 take turns in a relay ring (server.py:62-72 active-node rotation).
 
 TPU-native: the activation/gradient exchange is an explicit ``jax.vjp``
-boundary — the same two-program structure, jittable end to end. In
-simulation both halves run in one program; over the comm layer the
-activation/grad arrays are the wire payloads (never pickled modules).
-This is 2-stage pipeline parallelism; the cut generalizes to a mesh axis.
+boundary — the same two-program structure, jittable end to end. This module
+is the single-program simulation path (both halves in one jitted scan);
+``splitnn_dist.py`` runs the same protocol over the comm layer with the
+activation/grad arrays as wire payloads, bit-identical to this path
+(tests/test_comm_pipelines.py). This is 2-stage pipeline parallelism; the
+cut generalizes to a mesh axis.
 """
 
 from __future__ import annotations

@@ -1,0 +1,352 @@
+"""SplitNN over the message-passing comm layer.
+
+Reference: fedml_api/distributed/split_nn/ — the SERVER process holds the top
+half and the active client streams per-step activations to it
+(client.py:24-34 forward_pass/backward_pass over comm), the server finishes
+the forward, backprops and returns the activation gradient (server.py:40-60),
+and clients take turns in a relay ring (server.py:62-72 active-node
+rotation). This module is the real two-program path: server and clients are
+separate threads/processes on any comm backend (loopback for tests, shm for
+single-host multiprocess, grpc across hosts), and the activation / gradient
+arrays are the wire payloads — never pickled modules.
+
+Numerics contract: the per-step compute is factored into three jitted
+functions (``make_split_steps``) used identically by the wire path and by
+the in-process stepwise oracle ``run_splitnn_relay_stepwise``; the test
+suite asserts the loopback run is bit-identical to the oracle, and the
+oracle matches the single-program ``run_splitnn_relay`` scan
+(tests/test_comm_pipelines.py) — the same oracle discipline as multihost
+and is_mobile.
+
+Protocol state machines (handlers never block their receive loop):
+  server: INIT cvars0 -> START_TURN(key) -> [ACTS -> GRADS]* -> next turn
+          ... -> FINISHED -> collect FINAL_VARS -> stop
+  client: on START_TURN re-init the local optimizer (one relay turn = a
+          fresh client optimizer, matching run_splitnn_relay), then drive
+          step i from the GRADS handler for step i-1.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from fedml_tpu.algorithms.splitnn import SplitNN
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.managers import ClientManager, ServerManager
+from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
+
+Pytree = Any
+
+
+class SplitMsg:
+    """Message types (reference split_nn/message_define.py role)."""
+
+    MSG_TYPE_S2C_INIT = 1
+    MSG_TYPE_S2C_START_TURN = 2
+    MSG_TYPE_C2S_ACTS = 3
+    MSG_TYPE_S2C_GRADS = 4
+    MSG_TYPE_S2C_FINISHED = 5
+    MSG_TYPE_C2S_FINAL_VARS = 6
+
+    KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
+    KEY_DESC = "model_desc"
+    KEY_ACTS = "acts"
+    KEY_GRADS = "acts_grad"
+    KEY_STEP_KEY = "step_key"
+    KEY_TURN_KEY = "turn_key"
+    KEY_Y = "y"
+    KEY_MASK = "mask"
+    KEY_LAST = "last_step"
+
+
+def make_split_steps(split: SplitNN):
+    """The three per-step jitted programs of the split protocol. The wire
+    path and the in-process stepwise oracle call EXACTLY these, so the wire
+    adds serialization only — f32 arrays cross bit-exactly (comm/message.py).
+
+    ``client_backward`` recomputes the cut-layer forward inside ``jax.vjp``
+    (same inputs -> same program -> same bits as ``client_forward``): vjp
+    residuals never cross the wire, the standard split-learning recompute.
+    """
+
+    def _client_fwd(cvars, x, key):
+        def fwd(cp):
+            return split.client_module.apply(
+                {**cvars, "params": cp}, x, train=True, rngs={"dropout": key}
+            )
+
+        return fwd
+
+    @jax.jit
+    def client_forward(cvars, x, key):
+        return _client_fwd(cvars, x, key)(cvars["params"])
+
+    @jax.jit
+    def server_step(svars, s_opt_state, acts, y, mask, key):
+        # server.py:40-60 — finish forward, loss, backprop, return acts grad
+        def server_loss(sp, acts_in):
+            logits = split.server_module.apply(
+                {**svars, "params": sp}, acts_in, train=True, rngs={"dropout": key}
+            )
+            ce = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+            return jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+        loss = server_loss(svars["params"], acts)
+        s_grads, acts_grad = jax.grad(server_loss, argnums=(0, 1))(
+            svars["params"], acts
+        )
+        s_updates, s_opt_state = split.server_opt.update(
+            s_grads, s_opt_state, svars["params"]
+        )
+        new_sp = optax.apply_updates(svars["params"], s_updates)
+        return {**svars, "params": new_sp}, s_opt_state, acts_grad, loss
+
+    @jax.jit
+    def client_backward(cvars, c_opt_state, x, key, acts_grad):
+        # client.py:32-34 — the returned grad flows through the local half
+        _, vjp = jax.vjp(_client_fwd(cvars, x, key), cvars["params"])
+        (c_grads,) = vjp(acts_grad)
+        c_updates, c_opt_state = split.client_opt.update(
+            c_grads, c_opt_state, cvars["params"]
+        )
+        new_cp = optax.apply_updates(cvars["params"], c_updates)
+        return {**cvars, "params": new_cp}, c_opt_state
+
+    return client_forward, server_step, client_backward
+
+
+class SplitNNServerManager(ServerManager):
+    """Holds the top half; runs the relay rotation (server.py:62-72)."""
+
+    def __init__(self, comm: BaseCommunicationManager, split: SplitNN,
+                 n_clients: int, epochs: int, rng: jax.Array,
+                 cvars0: Pytree, svars: Pytree):
+        super().__init__(comm, rank=0, size=n_clients + 1)
+        self.split = split
+        self.n_clients = n_clients
+        self.total_turns = epochs * n_clients
+        _, self.server_step, _ = make_split_steps(split)
+        self.svars = svars
+        self.s_opt_state = split.server_opt.init(svars["params"])
+        self.rng = rng
+        self.turn = 0
+        self.losses: list[float] = []
+        self._turn_losses: list[jnp.ndarray] = []
+        self.final_cvars: dict[int, Pytree] = {}
+        self._flat0, self._desc = pack_pytree(jax.tree.map(np.asarray, cvars0))
+        self._lock = threading.Lock()
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(SplitMsg.MSG_TYPE_C2S_ACTS, self._on_acts)
+        self.register_message_receive_handler(
+            SplitMsg.MSG_TYPE_C2S_FINAL_VARS, self._on_final_vars
+        )
+
+    def send_init_msg(self) -> None:
+        for w in range(1, self.n_clients + 1):
+            msg = Message(SplitMsg.MSG_TYPE_S2C_INIT, 0, w)
+            msg.add_params(SplitMsg.KEY_MODEL, self._flat0)
+            msg.add_params(SplitMsg.KEY_DESC, self._desc)
+            self.send_message(msg)
+        self._start_turn()
+
+    def _start_turn(self) -> None:
+        # turn-key schedule identical to run_splitnn_relay's relay loop:
+        # rng, sub = split(rng) once per (epoch, client) in ring order
+        self.rng, sub = jax.random.split(self.rng)
+        active = (self.turn % self.n_clients) + 1
+        msg = Message(SplitMsg.MSG_TYPE_S2C_START_TURN, 0, active)
+        msg.add_params(SplitMsg.KEY_TURN_KEY, np.asarray(jax.random.key_data(sub)))
+        self.send_message(msg)
+
+    def _on_acts(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
+        acts = jnp.asarray(msg.get(SplitMsg.KEY_ACTS))
+        y = jnp.asarray(msg.get(SplitMsg.KEY_Y))
+        mask = jnp.asarray(msg.get(SplitMsg.KEY_MASK))
+        key = jax.random.wrap_key_data(jnp.asarray(msg.get(SplitMsg.KEY_STEP_KEY)))
+        self.svars, self.s_opt_state, acts_grad, loss = self.server_step(
+            self.svars, self.s_opt_state, acts, y, mask, key
+        )
+        self._turn_losses.append(loss)
+        out = Message(SplitMsg.MSG_TYPE_S2C_GRADS, 0, sender)
+        out.add_params(SplitMsg.KEY_GRADS, np.asarray(acts_grad))
+        self.send_message(out)
+        if msg.get(SplitMsg.KEY_LAST):
+            # same reduction as the scan path: mean of the f32 loss stack
+            self.losses.append(float(jnp.stack(self._turn_losses).mean()))
+            self._turn_losses = []
+            self.turn += 1
+            if self.turn >= self.total_turns:
+                for w in range(1, self.n_clients + 1):
+                    self.send_message(Message(SplitMsg.MSG_TYPE_S2C_FINISHED, 0, w))
+            else:
+                self._start_turn()
+
+    def _on_final_vars(self, msg: Message) -> None:
+        flat = np.asarray(msg.get(SplitMsg.KEY_MODEL))
+        with self._lock:
+            self.final_cvars[msg.get_sender_id()] = unpack_pytree(flat, self._desc)
+            done = len(self.final_cvars) == self.n_clients
+        if done:
+            self.finish()
+
+
+class SplitNNClientManager(ClientManager):
+    """Holds the bottom half + its shard; streams per-step activations."""
+
+    def __init__(self, comm: BaseCommunicationManager, rank: int, size: int,
+                 split: SplitNN, batches: dict[str, jnp.ndarray]):
+        super().__init__(comm, rank, size)
+        self.split = split
+        self.batches = batches  # [S, B, ...] stack
+        self.n_steps = int(np.shape(batches["x"])[0])
+        self.client_forward, _, self.client_backward = make_split_steps(split)
+        self.cvars: Pytree = None
+        self.c_opt_state = None
+        self.key = None
+        self._step_i = 0
+        self._step_key = None
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(SplitMsg.MSG_TYPE_S2C_INIT, self._on_init)
+        self.register_message_receive_handler(
+            SplitMsg.MSG_TYPE_S2C_START_TURN, self._on_start_turn
+        )
+        self.register_message_receive_handler(SplitMsg.MSG_TYPE_S2C_GRADS, self._on_grads)
+        self.register_message_receive_handler(
+            SplitMsg.MSG_TYPE_S2C_FINISHED, self._on_finished
+        )
+
+    def _on_init(self, msg: Message) -> None:
+        flat = np.asarray(msg.get(SplitMsg.KEY_MODEL))
+        self.cvars = jax.tree.map(
+            jnp.asarray, unpack_pytree(flat, msg.get(SplitMsg.KEY_DESC))
+        )
+
+    def _on_start_turn(self, msg: Message) -> None:
+        # a relay turn re-inits the local optimizer (run_splitnn_relay
+        # train_client: c_opt = client_opt.init per turn)
+        self.c_opt_state = self.split.client_opt.init(self.cvars["params"])
+        self.key = jax.random.wrap_key_data(
+            jnp.asarray(msg.get(SplitMsg.KEY_TURN_KEY))
+        )
+        self._step_i = 0
+        self._send_acts()
+
+    def _send_acts(self) -> None:
+        i = self._step_i
+        self.key, sub = jax.random.split(self.key)
+        self._step_key = sub
+        x = self.batches["x"][i]
+        acts = self.client_forward(self.cvars, x, sub)
+        msg = Message(SplitMsg.MSG_TYPE_C2S_ACTS, self.rank, 0)
+        msg.add_params(SplitMsg.KEY_ACTS, np.asarray(acts))
+        msg.add_params(SplitMsg.KEY_STEP_KEY, np.asarray(jax.random.key_data(sub)))
+        msg.add_params(SplitMsg.KEY_Y, np.asarray(self.batches["y"][i]))
+        msg.add_params(SplitMsg.KEY_MASK, np.asarray(self.batches["mask"][i]))
+        msg.add_params(SplitMsg.KEY_LAST, int(i == self.n_steps - 1))
+        self.send_message(msg)
+
+    def _on_grads(self, msg: Message) -> None:
+        acts_grad = jnp.asarray(msg.get(SplitMsg.KEY_GRADS))
+        x = self.batches["x"][self._step_i]
+        self.cvars, self.c_opt_state = self.client_backward(
+            self.cvars, self.c_opt_state, x, self._step_key, acts_grad
+        )
+        self._step_i += 1
+        if self._step_i < self.n_steps:
+            self._send_acts()
+        # else: turn over — wait for the next START_TURN or FINISHED
+
+    def _on_finished(self, msg: Message) -> None:
+        out = Message(SplitMsg.MSG_TYPE_C2S_FINAL_VARS, self.rank, 0)
+        flat, _ = pack_pytree(jax.tree.map(np.asarray, self.cvars))
+        out.add_params(SplitMsg.KEY_MODEL, flat)
+        self.send_message(out)
+        self.finish()
+
+
+def run_distributed_splitnn(
+    split: SplitNN,
+    client_batches: Sequence[dict[str, jnp.ndarray]],
+    epochs: int,
+    rng: jax.Array,
+    make_comm: Callable[[int], BaseCommunicationManager],
+):
+    """SplitNN relay over any comm fabric. Returns (cvars per client, svars,
+    per-turn losses) — the same contract as ``run_splitnn_relay``."""
+    from fedml_tpu.algorithms.fedavg_distributed import run_manager_protocol
+
+    sample_x = jax.tree.map(lambda v: v[0], client_batches[0])["x"]
+    cvars0, svars = split.init(rng, sample_x)
+
+    server = SplitNNServerManager(
+        make_comm(0), split, len(client_batches), epochs, rng, cvars0, svars
+    )
+    clients = [
+        SplitNNClientManager(make_comm(r), r, len(client_batches) + 1, split, b)
+        for r, b in enumerate(client_batches, start=1)
+    ]
+    run_manager_protocol(server, clients)
+    cvars = [
+        jax.tree.map(jnp.asarray, server.final_cvars[r])
+        for r in range(1, len(client_batches) + 1)
+    ]
+    return cvars, server.svars, server.losses
+
+
+def run_distributed_splitnn_loopback(split, client_batches, epochs, rng):
+    """SplitNN relay on the in-process loopback fabric."""
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(len(client_batches) + 1)
+    return run_distributed_splitnn(
+        split, client_batches, epochs, rng,
+        lambda r: LoopbackCommManager(fabric, r),
+    )
+
+
+def run_splitnn_relay_stepwise(
+    split: SplitNN,
+    client_batches: Sequence[dict[str, jnp.ndarray]],
+    epochs: int,
+    rng: jax.Array,
+):
+    """In-process oracle: the SAME per-step jitted programs as the wire path,
+    driven sequentially with no comm layer. Bit-comparable to
+    ``run_distributed_splitnn`` by construction; cross-checked against the
+    single-program ``run_splitnn_relay`` scan in tests."""
+    client_forward, server_step, client_backward = make_split_steps(split)
+    sample_x = jax.tree.map(lambda v: v[0], client_batches[0])["x"]
+    cvars0, svars = split.init(rng, sample_x)
+    cvars = [jax.tree.map(jnp.copy, cvars0) for _ in client_batches]
+    s_opt_state = split.server_opt.init(svars["params"])
+
+    losses = []
+    for _ in range(epochs):
+        for ci, batches in enumerate(client_batches):  # relay ring
+            rng, sub = jax.random.split(rng)
+            c_opt_state = split.client_opt.init(cvars[ci]["params"])
+            key = sub
+            turn_losses = []
+            for i in range(int(np.shape(batches["x"])[0])):
+                key, step_key = jax.random.split(key)
+                x, y, mask = batches["x"][i], batches["y"][i], batches["mask"][i]
+                acts = client_forward(cvars[ci], x, step_key)
+                svars, s_opt_state, acts_grad, loss = server_step(
+                    svars, s_opt_state, acts, y, mask, step_key
+                )
+                turn_losses.append(loss)
+                cvars[ci], c_opt_state = client_backward(
+                    cvars[ci], c_opt_state, x, step_key, acts_grad
+                )
+            losses.append(float(jnp.stack(turn_losses).mean()))
+    return cvars, svars, losses

@@ -200,16 +200,23 @@ class TAServerManager(ServerManager):
                 else tuple(range(1, self.worker_num + 1))
             )
             sender = msg.get_sender_id()
-            self._share_sums[sender] = (
-                include, np.asarray(msg.get(TAMessage.KEY_SHARE))
-            )
             if self._include_sent and include != tuple(self._include_set):
                 # a share-sum arriving AFTER the inclusion-set decision with
                 # a different set (e.g. a slow full-set holder) never saw the
                 # broadcast — resend it so this sender can resubmit into the
                 # agreed bucket, otherwise the round can stall with subset
-                # sums and full sums that never reach t+1 in any one bucket
+                # sums and full sums that never reach t+1 in any one bucket.
+                # The mismatched sum is NOT stored: once subset recovery is
+                # active the privacy guard's invariant (full-set submissions
+                # <= t while a t+1 subset bucket may form) must hold at
+                # every instant, and storing a late full-set sum could
+                # transiently give the server t+1 points on BOTH polynomials
+                # — whose difference is the dead client's individual update
                 resend_to = (sender, self._include_set, self.round_idx)
+            else:
+                self._share_sums[sender] = (
+                    include, np.asarray(msg.get(TAMessage.KEY_SHARE))
+                )
             got = len(self._share_sums)
             if (got == 1 and self.round_timeout is not None
                     and self._timer is None and not self._timed_out):

@@ -9,8 +9,10 @@ fedml_api/standalone/classical_vertical_fl/party_models.py:12,81
 
 TPU-native: the feature dimension is partitioned across parties — structurally
 tensor parallelism. The batch-synchronous two-phase protocol is an explicit
-``jax.vjp`` per party; in one process the whole round jits into a single
-program, and over the comm layer the logit/gradient arrays are the payloads.
+``jax.vjp`` per party; this module is the single-program simulation path
+(the whole round jits into one program). ``vertical_dist.py`` runs the same
+protocol over the comm layer with the logit/gradient arrays as wire
+payloads, bit-identical to this path (tests/test_comm_pipelines.py).
 """
 
 from __future__ import annotations

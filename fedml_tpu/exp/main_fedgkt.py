@@ -29,6 +29,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--temperature", type=float, default=3.0)
     parser.add_argument("--alpha", type=float, default=1.0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--backend", type=str, default="inprocess",
+                        choices=["inprocess", "loopback"],
+                        help="inprocess: orchestrated in this process; "
+                             "loopback: server + clients as separate threads "
+                             "with features/logits as wire payloads")
     return parser
 
 
@@ -81,44 +86,35 @@ def run(args) -> dict:
         stack, _ = stack_cohort(train, np.asarray([c]), args.batch_size)
         client_batches.append(jax.tree.map(lambda v: jnp.asarray(v[0]), stack))
 
-    sample = client_batches[0]["x"][0]
-    cvars_list = []
-    svars = None
-    for c in range(train.num_clients):
-        cv, sv = gkt.init(jax.random.fold_in(jax.random.key(args.seed), c), sample)
-        cvars_list.append(cv)
-        svars = sv  # one shared server model
+    # both backends run the SAME orchestration semantics (run_fedgkt is the
+    # numerics oracle of the distributed path): identical args + seed give
+    # identical models whichever backend is chosen
+    if args.backend == "loopback":
+        from fedml_tpu.algorithms.fedgkt_dist import run_distributed_fedgkt_loopback
 
-    client_train = jax.jit(gkt.client_train, static_argnums=3)
-    server_train = jax.jit(gkt.server_train, static_argnums=5)
-
-    S = client_batches[0]["y"].shape[0]
-    feedback = [jnp.zeros((S, args.batch_size, class_num)) for _ in range(train.num_clients)]
-    final_loss = float("nan")
-    for r in range(args.comm_round):
-        feats_all, clogits_all, ys, ms = [], [], [], []
-        for c in range(train.num_clients):
-            cvars_list[c], feats, clogits = client_train(
-                cvars_list[c], client_batches[c], feedback[c],
-                args.epochs_client, jax.random.key(r * 1000 + c),
-            )
-            feats_all.append(feats)
-            clogits_all.append(clogits)
-            ys.append(client_batches[c]["y"])
-            ms.append(client_batches[c]["mask"])
-        # server consumes the concatenated per-batch uploads
-        feats_cat = jnp.concatenate(feats_all)
-        clog_cat = jnp.concatenate(clogits_all)
-        svars, slogits = server_train(
-            svars, feats_cat, clog_cat, jnp.concatenate(ys), jnp.concatenate(ms),
-            args.epochs_server,
+        cvars_list, svars = run_distributed_fedgkt_loopback(
+            gkt, client_batches, rounds=args.comm_round,
+            client_epochs=args.epochs_client, server_epochs=args.epochs_server,
+            rng=jax.random.key(args.seed),
         )
-        feedback = list(jnp.split(slogits, train.num_clients))
-        logging.info("gkt round %d done", r)
+    else:
+        from fedml_tpu.algorithms.fedgkt import run_fedgkt
 
-    # final train accuracy through the full client->server pipeline
+        cvars_list, svars, _ = run_fedgkt(
+            gkt, client_batches, rounds=args.comm_round,
+            client_epochs=args.epochs_client, server_epochs=args.epochs_server,
+            rng=jax.random.key(args.seed),
+        )
+    return _final_metrics(gkt, cvars_list, svars, client_batches)
+
+
+def _final_metrics(gkt, cvars_list, svars, client_batches) -> dict:
+    """Final train accuracy through the full client->server pipeline."""
+    import jax
+    import jax.numpy as jnp
+
     correct = total = 0.0
-    for c in range(train.num_clients):
+    for c in range(len(client_batches)):
         feats, _ = jax.vmap(
             lambda b_x: gkt.client_module.apply(cvars_list[c], b_x, train=False)
         )(client_batches[c]["x"])

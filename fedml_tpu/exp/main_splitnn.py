@@ -25,6 +25,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--epochs", type=int, default=4)
     parser.add_argument("--hidden", type=int, default=32)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--backend", type=str, default="inprocess",
+                        choices=["inprocess", "loopback", "shm"],
+                        help="inprocess: single jitted program; loopback/shm: "
+                             "server + clients as separate threads with "
+                             "activations/grads as wire payloads "
+                             "(bit-identical)")
     return parser
 
 
@@ -69,9 +75,35 @@ def run(args) -> dict:
         stack, _ = stack_cohort(ds.train, np.asarray([c]), args.batch_size)
         client_batches.append(jax.tree.map(lambda v: jnp.asarray(v[0]), stack))
 
-    cvars, svars, losses = run_splitnn_relay(
-        split, client_batches, epochs=args.epochs, rng=jax.random.key(args.seed)
-    )
+    if args.backend == "loopback":
+        from fedml_tpu.algorithms.splitnn_dist import run_distributed_splitnn_loopback
+
+        cvars, svars, losses = run_distributed_splitnn_loopback(
+            split, client_batches, epochs=args.epochs, rng=jax.random.key(args.seed)
+        )
+    elif args.backend == "shm":
+        import uuid
+
+        from fedml_tpu.algorithms.splitnn_dist import run_distributed_splitnn
+        from fedml_tpu.comm.shm import ShmCommManager
+
+        job = f"splitnn_{uuid.uuid4().hex[:8]}"
+        mgrs = {
+            r: ShmCommManager(job, r, len(client_batches) + 1)
+            for r in range(len(client_batches) + 1)
+        }
+        try:
+            cvars, svars, losses = run_distributed_splitnn(
+                split, client_batches, epochs=args.epochs,
+                rng=jax.random.key(args.seed), make_comm=lambda r: mgrs[r],
+            )
+        finally:
+            for m in mgrs.values():
+                m.cleanup()
+    else:
+        cvars, svars, losses = run_splitnn_relay(
+            split, client_batches, epochs=args.epochs, rng=jax.random.key(args.seed)
+        )
     out = {"Train/Loss": float(losses[-1])}
     if ds.test_arrays is not None:
         test_b = jax.tree.map(jnp.asarray, batch_array(ds.test_arrays, 64))

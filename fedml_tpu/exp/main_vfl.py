@@ -24,13 +24,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--epochs", type=int, default=8)
     parser.add_argument("--hidden", type=int, default=16)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--backend", type=str, default="inprocess",
+                        choices=["inprocess", "loopback"],
+                        help="inprocess: single jitted program; loopback: "
+                             "guest + hosts as separate threads over the "
+                             "comm layer (bit-identical)")
     return parser
 
 
 def run(args) -> dict:
+    import jax
     import jax.numpy as jnp
+    import optax
 
-    from fedml_tpu.algorithms.vertical import run_vfl
+    from fedml_tpu.algorithms.vertical import PartyModel, VerticalFL, run_vfl
     from fedml_tpu.data.vertical_tabular import load_vertical, synthetic_vertical
     from fedml_tpu.obs.metrics import logging_config
 
@@ -45,11 +52,23 @@ def run(args) -> dict:
             args.dataset, args.data_dir, n_parties=args.party_num, seed=args.seed
         )
 
-    vfl, pvars, losses = run_vfl(
-        [jnp.asarray(s) for s in tr_splits], jnp.asarray(y_tr),
-        epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
-        hidden=args.hidden, seed=args.seed,
-    )
+    if args.backend == "loopback":
+        from fedml_tpu.algorithms.vertical_dist import run_distributed_vfl_loopback
+
+        vfl = VerticalFL(
+            [PartyModel(hidden=args.hidden) for _ in tr_splits],
+            optax.sgd(args.lr),
+        )
+        pvars, losses = run_distributed_vfl_loopback(
+            vfl, [jnp.asarray(s) for s in tr_splits], jnp.asarray(y_tr),
+            args.epochs, args.batch_size, jax.random.key(args.seed),
+        )
+    else:
+        vfl, pvars, losses = run_vfl(
+            [jnp.asarray(s) for s in tr_splits], jnp.asarray(y_tr),
+            epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
+            hidden=args.hidden, seed=args.seed,
+        )
     pred = np.asarray(vfl.predict(pvars, [jnp.asarray(s) for s in te_splits])) > 0.5
     out = {
         "Train/Loss": float(losses[-1]),

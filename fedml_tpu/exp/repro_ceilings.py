@@ -39,6 +39,8 @@ def centralized_ceiling(trainer, train_arrays, test_arrays, batch_size,
     from fedml_tpu.core.trainer import make_local_eval, make_local_train
     from fedml_tpu.sim.cohort import batch_array
 
+    if epochs < 1:
+        raise ValueError(f"centralized_ceiling needs epochs >= 1, got {epochs}")
     rng = np.random.RandomState(seed)
     n = len(train_arrays["y"])
     # ONE shuffle + ONE device upload: per-epoch host reshuffles would ship

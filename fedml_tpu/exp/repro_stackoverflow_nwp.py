@@ -53,6 +53,17 @@ def run(args) -> dict:
         and not is_fixture(data_dir, "stackoverflow_nwp")
     )
     if not real:
+        if args.seq_len <= args.fixture_sentence_len:
+            # a shorter window truncates sentences: the per-token ceiling
+            # and eos floor below would describe a DIFFERENT task than the
+            # one trained (tff_fixture.stackoverflow_bayes_ceiling assumes
+            # the full sentence + eos fit in the window)
+            raise ValueError(
+                f"--seq_len ({args.seq_len}) must exceed "
+                f"--fixture_sentence_len ({args.fixture_sentence_len}); the "
+                "reported Bayes ceiling / eos floor assume untruncated "
+                "fixture sentences"
+            )
         logging.info(
             "no real stackoverflow h5 at %s — writing the %d-client "
             "schema-exact fixture (idempotent)", data_dir,

@@ -1,0 +1,192 @@
+"""Optional-dependency integration arms for the production transports.
+
+The reference's production backend is MQTT + S3
+(mqtt_s3_multi_clients_comm_manager.py:20 real paho client,
+remote_storage.py:14 real boto3 client). This image ships neither
+paho-mqtt nor boto3/moto, so the repo's regular suite exercises the full
+MqttCommManager/S3-offload LOGIC against in-process substitutes
+(comm/inproc_broker.py, tests/test_comm.py) — honestly flagged in
+COVERAGE.md as "fake-broker-verified".
+
+These tests are the graduation path: the day the real dependencies (and a
+local broker) exist, they run the SAME federated round over the real paho
+socket client and the real boto3 client against moto's S3 — with zero code
+changes. Here they skip cleanly via importorskip.
+
+Run requirements when deps are available:
+- paho tests: a broker on localhost:1883 (``mosquitto -p 1883``), or set
+  FEDML_TPU_TEST_MQTT_HOST / _PORT.
+- S3 tests: moto (in-process mock S3) — no network.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+MQTT_HOST = os.environ.get("FEDML_TPU_TEST_MQTT_HOST", "localhost")
+MQTT_PORT = int(os.environ.get("FEDML_TPU_TEST_MQTT_PORT", "1883"))
+
+
+def _broker_reachable() -> bool:
+    import socket
+
+    try:
+        with socket.create_connection((MQTT_HOST, MQTT_PORT), timeout=1.0):
+            return True
+    except OSError:
+        return False
+
+
+@pytest.fixture
+def mqtt_available():
+    pytest.importorskip("paho.mqtt.client")
+    if not _broker_reachable():
+        pytest.skip(f"no MQTT broker at {MQTT_HOST}:{MQTT_PORT}")
+
+
+def test_real_paho_round_trip(mqtt_available):
+    """One typed binary message server->client over a REAL paho socket
+    connection (the arm the in-process broker cannot cover: socket I/O,
+    paho threading, MQTT protocol framing)."""
+    import threading
+    import uuid
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+    topic = f"fedml_it_{uuid.uuid4().hex[:8]}"
+    server = MqttCommManager(MQTT_HOST, MQTT_PORT, topic=topic,
+                             client_id=0, client_num=1)
+    client = MqttCommManager(MQTT_HOST, MQTT_PORT, topic=topic,
+                             client_id=1, client_num=1)
+    got = []
+    done = threading.Event()
+
+    class Obs:
+        def receive_message(self, msg_type, msg):
+            got.append(msg)
+            done.set()
+
+    client.add_observer(Obs())
+    t = threading.Thread(target=client.handle_receive_message, daemon=True)
+    t.start()
+    try:
+        msg = Message(7, 0, 1)
+        msg.add_params("payload", np.arange(1024, dtype=np.float32))
+        server.send_message(msg)
+        assert done.wait(10.0), "message never crossed the real broker"
+        assert got[0].get_type() == 7
+        np.testing.assert_array_equal(
+            np.asarray(got[0].get("payload")), np.arange(1024, dtype=np.float32)
+        )
+    finally:
+        client.stop_receive_message()
+        server.stop_receive_message()
+
+
+def test_real_paho_distributed_fedavg(mqtt_available):
+    """A full 2-client federated round over the real broker + filesystem
+    offload — the production MQTT_S3 shape end to end."""
+    import tempfile
+    import uuid
+
+    import jax
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_mqtt_s3,
+    )
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.cohort import FederatedArrays
+
+    rng = np.random.RandomState(0)
+    n = 64
+    train = FederatedArrays(
+        {"x": rng.rand(n, 8).astype(np.float32),
+         "y": rng.randint(0, 2, n).astype(np.int32)},
+        {0: np.arange(32), 1: np.arange(32, 64)},
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=2),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    with tempfile.TemporaryDirectory() as store:
+        final = run_distributed_fedavg_mqtt_s3(
+            trainer, train, worker_num=2, round_num=2, batch_size=16,
+            store_dir=store, mqtt_host=MQTT_HOST, mqtt_port=MQTT_PORT,
+            topic=f"fedml_it_{uuid.uuid4().hex[:8]}",
+        )
+    flat = np.concatenate([np.ravel(v) for v in
+                           jax.tree_util.tree_leaves(final)])
+    assert np.isfinite(flat).all()
+
+
+@pytest.fixture
+def s3_available():
+    pytest.importorskip("boto3")
+    pytest.importorskip("moto")
+
+
+def test_real_boto3_s3_store_round_trip(s3_available):
+    """S3Store.put/get through the real boto3 client against moto's
+    in-process S3 — covers the request-signing/serialization arm the
+    FileSystemStore substitute cannot."""
+    import moto
+
+    with moto.mock_aws():
+        import boto3
+
+        boto3.client("s3", region_name="us-east-1").create_bucket(
+            Bucket="fedml-test"
+        )
+        from fedml_tpu.comm.object_store import S3Store
+
+        store = S3Store(bucket="fedml-test", region_name="us-east-1")
+        payload = np.random.RandomState(0).bytes(1 << 16)
+        store.put("models/round0", payload)
+        assert store.get("models/round0") == payload
+
+
+def test_real_boto3_offload_comm(s3_available):
+    """OffloadCommManager with the REAL S3Store over loopback: large array
+    payloads ride S3 by key, small headers stay inline."""
+    import threading
+
+    import moto
+
+    with moto.mock_aws():
+        import boto3
+
+        boto3.client("s3", region_name="us-east-1").create_bucket(
+            Bucket="fedml-test"
+        )
+        from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+        from fedml_tpu.comm.message import Message
+        from fedml_tpu.comm.object_store import OffloadCommManager, S3Store
+
+        fabric = LoopbackFabric(2)
+        store = S3Store(bucket="fedml-test", region_name="us-east-1")
+        sender = OffloadCommManager(LoopbackCommManager(fabric, 0), store,
+                                    threshold_bytes=1 << 10)
+        receiver = OffloadCommManager(LoopbackCommManager(fabric, 1), store,
+                                      threshold_bytes=1 << 10)
+        got = []
+        done = threading.Event()
+
+        class Obs:
+            def receive_message(self, msg_type, msg):
+                got.append(msg)
+                done.set()
+
+        receiver.add_observer(Obs())
+        t = threading.Thread(target=receiver.handle_receive_message, daemon=True)
+        t.start()
+        big = np.random.RandomState(1).rand(4096).astype(np.float32)
+        msg = Message(3, 0, 1)
+        msg.add_params("model_params", big)
+        sender.send_message(msg)
+        assert done.wait(10.0)
+        np.testing.assert_array_equal(np.asarray(got[0].get("model_params")), big)
+        receiver.stop_receive_message()

@@ -1,0 +1,116 @@
+"""Pre-populate the persistent XLA compile cache for the test gate.
+
+Most of the suite's cold wall-clock is XLA:CPU compilation of federated
+round programs; many tests rebuild the same program shapes. This script
+compiles the highest-cost SHARED programs once so a following
+``pytest -m "not slow"`` run is close to its warm-cache time (~5 min on a
+single core) instead of the cold 20+ min.
+
+Usage (fresh clone):
+    python tools/prime_cache.py          # ~3-6 min single-core, one-time
+    python -m pytest tests/ -q -m "not slow"
+
+The cache lives at $FEDML_TPU_JAX_CACHE (default /tmp/fedml_tpu_jax_cache)
+— the same directory tests/conftest.py configures — and is content-addressed,
+so priming is idempotent and safe to re-run.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("FEDML_TPU_JAX_CACHE", "/tmp/fedml_tpu_jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def _t(label, fn):
+    t0 = time.time()
+    fn()
+    print(f"  {label}: {time.time() - t0:.1f}s", flush=True)
+
+
+def main():
+    import numpy as np
+
+    import jax.numpy as jnp
+    import optax
+
+    print("priming XLA compile cache "
+          f"({jax.config.jax_compilation_cache_dir}) ...", flush=True)
+
+    # 1. the graft-entry dryrun: 2-D mesh round + ring-attention SP step —
+    #    the driver gate's exact programs
+    import __graft_entry__ as graft
+
+    _t("dryrun_multichip(8)", lambda: graft.dryrun_multichip(8))
+
+    # 2. the flagship single-chip forward (entry contract)
+    def entry_fwd():
+        fn, args = graft.entry()
+        jax.jit(fn)(*args)
+
+    _t("entry() forward", entry_fwd)
+
+    # 3. the equivalence-oracle round shape shared by many engine tests:
+    #    vmapped cohort + scan epochs on the 2-conv CNN
+    def engine_round():
+        from fedml_tpu.core.trainer import ClientTrainer
+        from fedml_tpu.data.synthetic import gaussian_blobs
+        from fedml_tpu.models.cnn import CNNOriginalFedAvg
+        from fedml_tpu.sim.engine import FedSim, SimConfig
+
+        train, test = gaussian_blobs(
+            n_clients=4, samples_per_client=16, num_classes=4,
+            dim=4 * 4 * 3, seed=0,
+        )
+        for arrays in (train.arrays, test):
+            arrays["x"] = arrays["x"].reshape(-1, 4, 4, 3)
+        trainer = ClientTrainer(
+            module=CNNOriginalFedAvg(num_classes=4),
+            optimizer=optax.sgd(0.1, momentum=0.9), epochs=1,
+        )
+        cfg = SimConfig(client_num_in_total=4, client_num_per_round=4,
+                        batch_size=8, comm_round=1, epochs=1,
+                        frequency_of_the_test=1, seed=0)
+        FedSim(trainer, train, test, cfg).run()
+
+    _t("engine round (CNN)", engine_round)
+
+    # 4. the distributed-manager local_train jit (fedavg_distributed tests)
+    def dist_local():
+        from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+        from fedml_tpu.models.lr import LogisticRegression
+
+        trainer = ClientTrainer(
+            module=LogisticRegression(input_dim=8, class_num=2),
+            optimizer=optax.sgd(0.1), epochs=1,
+        )
+        batches = {
+            "x": jnp.zeros((2, 8, 8), jnp.float32),
+            "y": jnp.zeros((2, 8), jnp.int32),
+            "mask": jnp.ones((2, 8), jnp.float32),
+        }
+        variables = trainer.init(jax.random.key(0),
+                                 jax.tree.map(lambda v: v[0], batches))
+        jax.jit(make_local_train(trainer))(variables, batches,
+                                           jax.random.key(1))
+
+    _t("distributed local_train (LR)", dist_local)
+
+    print("cache primed.", flush=True)
+
+
+if __name__ == "__main__":
+    main()
