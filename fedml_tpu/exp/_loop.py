@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import time
 
 
@@ -27,6 +28,13 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
     from fedml_tpu.core import rng as rnglib
 
     records: list[dict] = []
+    # clear any stale stop sentinel BEFORE the loop: a leftover file from a
+    # run that ended another way (exception, stop_when) must not silently
+    # truncate THIS run to one round
+    try:
+        os.unlink(metrics_out + ".stop")
+    except FileNotFoundError:
+        pass
     variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     root = rnglib.root_key(cfg.seed)
@@ -54,6 +62,18 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
             if evaled and stop_when is not None and stop_when(records):
                 logging.info(
                     "stop_when fired at round %d — stopping early", r
+                )
+                break
+            if os.path.exists(metrics_out + ".stop"):
+                # graceful external stop: `touch <metrics_out>.stop` ends the
+                # run after the current round WITH the final report written —
+                # a SIGTERM would lose it (partial curves stay reportable).
+                # Consumed on use: a leftover sentinel must not kill the
+                # next run at round 0.
+                os.unlink(metrics_out + ".stop")
+                logging.info(
+                    "stop file %s.stop found at round %d — stopping",
+                    metrics_out, r,
                 )
                 break
             if round_sleep:

@@ -54,6 +54,12 @@ class SimConfig:
     # Server-side per-client evaluation at test frequency (reference
     # FedAVGAggregator.test_on_server_for_all_clients, FedAVGAggregator.py:110-164)
     eval_on_clients: bool = False
+    # Cap the POOLED-TRAIN eval to the first N samples (None = all). For
+    # population-scale rows (StackOverflow: 2.4M host-resident sequences)
+    # evaluating the full train pool per test round is the reference's own
+    # hidden bottleneck (SURVEY §7 "Eval cost ... vectorize it or sample");
+    # Train/Acc becomes a fixed-subset estimate, Test metrics are untouched.
+    train_eval_samples: int | None = None
     # Keep the training arrays resident on device and gather each round's
     # cohort inside the jitted program — per-round host->device traffic drops
     # from the full batch stack to a [C, S, B] int32 index array. None = auto
@@ -229,8 +235,11 @@ class FedSim:
         self._train_eval_batches = None
         self._train_eval_idx = None
         if self._can_eval:
+            n_eval = train_data.num_samples
+            if config.train_eval_samples is not None:
+                n_eval = min(n_eval, config.train_eval_samples)
             if self._on_device:
-                n = train_data.num_samples
+                n = n_eval
                 bs = config.eval_batch_size
                 steps = cohortlib.steps_per_epoch(n, bs)
                 eidx = np.full(steps * bs, -1, np.int32)
@@ -248,7 +257,8 @@ class FedSim:
                 )
             else:
                 self._train_eval_batches = cohortlib.batch_array(
-                    train_data.arrays, config.eval_batch_size
+                    {k: v[:n_eval] for k, v in train_data.arrays.items()},
+                    config.eval_batch_size,
                 )
 
 

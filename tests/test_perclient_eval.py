@@ -89,3 +89,45 @@ def test_per_client_eval_resident_matches_host_path():
     m_off = off.evaluate_per_client(off.init_round_variables(), chunk=4)
     for k in m_off:
         np.testing.assert_allclose(m_on[k], m_off[k], rtol=1e-6)
+
+
+@pytest.mark.parametrize("stage_on_device", [True, False])
+def test_train_eval_samples_caps_pooled_train_eval(stage_on_device):
+    """``train_eval_samples`` restricts the pooled-train eval to the first N
+    samples in BOTH staging modes (host batches and resident-index gather);
+    the capped run must equal a run whose dataset IS that subset."""
+    train, test = gaussian_blobs(
+        n_clients=4, samples_per_client=30, num_classes=4, seed=5
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.3),
+        epochs=1,
+    )
+    n_cap = 50
+    cfg = dict(
+        client_num_in_total=4, client_num_per_round=4, batch_size=10,
+        comm_round=1, frequency_of_the_test=1, seed=0,
+        stage_on_device=stage_on_device,
+    )
+    sim_capped = FedSim(
+        trainer, train, dict(test), SimConfig(**cfg, train_eval_samples=n_cap)
+    )
+    variables = sim_capped.init_round_variables()
+    capped = sim_capped.evaluate(variables)
+
+    # oracle: a sim whose TRAIN POOL is exactly the first n_cap samples
+    from fedml_tpu.sim.cohort import FederatedArrays
+
+    sub_arrays = {k: v[:n_cap] for k, v in train.arrays.items()}
+    sub_part = {0: np.arange(n_cap)}
+    sub = FederatedArrays(sub_arrays, sub_part)
+    sim_sub = FedSim(
+        trainer, sub, dict(test),
+        SimConfig(**{**cfg, "client_num_in_total": 1, "client_num_per_round": 1}),
+    )
+    full = sim_sub.evaluate(variables)
+    assert capped["Train/Acc"] == pytest.approx(full["Train/Acc"], abs=1e-6)
+    assert capped["Train/Loss"] == pytest.approx(full["Train/Loss"], abs=1e-5)
+    # test metrics are NOT capped
+    assert capped["Test/Acc"] == pytest.approx(full["Test/Acc"], abs=1e-6)
