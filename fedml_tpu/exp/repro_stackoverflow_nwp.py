@@ -175,8 +175,11 @@ def run(args) -> dict:
 
 def _write_report(path: Path, args, result: dict, evals: list,
                   real: bool) -> None:
+    import jax
+
     from fedml_tpu.exp._report import acc_curve, update_section
 
+    platform = jax.devices()[0].platform  # honest: chip vs XLA:CPU fallback
     curve = acc_curve(evals, points=12)
     if real:
         note = "Real StackOverflow h5 archives were used."
@@ -225,12 +228,12 @@ SGD lr=10^-0.5, E=1, RNN_StackOverFlow (1x670 LSTM + 2 FC).
 
 - best test accuracy: **{result['best_test_acc'] * 100:.2f}**
 {ceiling_line}- first round with test acc > 19.5: **{result['first_round_over_19.5']}**
-- wall-clock: {result['rounds_per_sec']} rounds/sec on this chip (host-staged cohorts)
+- wall-clock: {result['rounds_per_sec']} rounds/sec on this host's `{platform}` backend (host-staged cohorts)
 - raw per-round metrics: `{args.metrics_out}`
 
 Accuracy curve (round:acc): {curve}
 
-Reproduce with: `python -m fedml_tpu.exp.repro_stackoverflow_nwp --out REPRO.md`
+Reproduce with: `python -m fedml_tpu.exp.repro_stackoverflow_nwp --test_clients {args.test_clients} --fixture_max_sent {args.fixture_max_sent} --train_eval_samples {args.train_eval_samples} --frequency_of_the_test {args.frequency_of_the_test} --out REPRO.md`
 """)
 
 
