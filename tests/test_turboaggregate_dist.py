@@ -421,3 +421,40 @@ def test_pre_share_drop_recovers_without_round_timeout():
     )
     flat = np.concatenate([np.ravel(np.asarray(l)) for l in jax.tree.leaves(got)])
     assert np.all(np.isfinite(flat))
+
+
+def test_superseded_full_set_sum_is_not_stored():
+    """Privacy-guard invariant (round-5): once the inclusion set is agreed,
+    a share-sum over a DIFFERENT (e.g. full) set must be answered with a
+    resend of the agreed set and must NOT enter ``_share_sums`` — storing
+    it could transiently give the server t+1 points on BOTH polynomials,
+    whose difference is the dead client's individual update."""
+    from fedml_tpu.algorithms.turboaggregate_dist import TAServerManager
+
+    fabric = LoopbackFabric(5)
+    server = TAServerManager(
+        LoopbackCommManager(fabric, 0), worker_num=4, round_num=1,
+        init_flat=np.zeros(8, np.uint8), model_desc="[]", threshold=2,
+    )
+    server._include_sent = True
+    server._include_set = [1, 2, 3]
+
+    msg = Message(TAMessage.MSG_TYPE_C2S_SHARE_SUM, 1, 0)
+    msg.add_params(TAMessage.KEY_ROUND, 0)
+    msg.add_params(TAMessage.KEY_INCLUDE, [1, 2, 3, 4])  # full set: superseded
+    msg.add_params(TAMessage.KEY_SHARE, np.arange(4, dtype=np.int64))
+    server._on_share_sum(msg)
+
+    assert 1 not in server._share_sums, "superseded full-set sum was stored"
+    # and the sender was told the agreed set so it can resubmit
+    resend = Message.from_bytes(fabric.queues[1].get_nowait())
+    assert resend.get_type() == TAMessage.MSG_TYPE_S2C_INCLUDE
+    assert list(resend.get(TAMessage.KEY_INCLUDE)) == [1, 2, 3]
+
+    # a sum over the AGREED set is stored normally
+    ok = Message(TAMessage.MSG_TYPE_C2S_SHARE_SUM, 2, 0)
+    ok.add_params(TAMessage.KEY_ROUND, 0)
+    ok.add_params(TAMessage.KEY_INCLUDE, [1, 2, 3])
+    ok.add_params(TAMessage.KEY_SHARE, np.arange(4, dtype=np.int64))
+    server._on_share_sum(ok)
+    assert 2 in server._share_sums
