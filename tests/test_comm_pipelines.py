@@ -119,6 +119,76 @@ def test_splitnn_over_shm_ring():
     assert l1 == l2
 
 
+def test_splitnn_real_processes(tmp_path):
+    """The reference's ACTUAL process model: each client is a separate OS
+    process (split_nn/client.py), here joined to the parent's server over
+    the native C++ shm ring — bit-identical to the in-process oracle."""
+    import subprocess
+    import sys
+    import uuid
+
+    from fedml_tpu.algorithms.splitnn_dist import SplitNNServerManager
+    from fedml_tpu.comm.shm import ShmCommManager
+
+    split, cb = _split_setup(n_clients=2)
+    cv1, sv1, l1 = run_splitnn_relay_stepwise(split, cb, epochs=1, rng=jax.random.key(0))
+
+    job = f"sp_{uuid.uuid4().hex[:8]}"
+    workers = []
+    worker_src = str(
+        __import__("pathlib").Path(__file__).parent / "_splitnn_worker.py"
+    )
+    for r, batches in enumerate(cb, start=1):
+        npz = tmp_path / f"client{r}.npz"
+        np.savez(npz, **{k: np.asarray(v) for k, v in batches.items()})
+        workers.append(subprocess.Popen(
+            [sys.executable, worker_src, job, str(r), str(len(cb) + 1), str(npz)]
+        ))
+
+    # server in THIS process (mirrors run_distributed_splitnn's setup)
+    sample_x = jax.tree.map(lambda v: v[0], cb[0])["x"]
+    cvars0, svars = split.init(jax.random.key(0), sample_x)
+    comm = ShmCommManager(job, 0, len(cb) + 1)
+    server = SplitNNServerManager(
+        comm, split, len(cb), 1, jax.random.key(0), cvars0, svars
+    )
+    import threading
+
+    protocol_done = threading.Event()
+
+    def watchdog():
+        # a child that dies before FINAL_VARS would leave the server's
+        # receive loop waiting forever — break it so the test FAILS (on the
+        # final_cvars count) instead of hanging the suite
+        while not protocol_done.wait(1.0):
+            if any(w.poll() is not None and w.returncode != 0 for w in workers):
+                server.finish()
+                return
+
+    guard = threading.Thread(target=watchdog, daemon=True)
+    guard.start()
+    try:
+        server.register_message_receive_handlers()
+        server.send_init_msg()
+        server.comm.handle_receive_message()  # until all FINAL_VARS arrive
+        protocol_done.set()
+        assert len(server.final_cvars) == len(cb), "a worker died mid-protocol"
+        for w in workers:
+            assert w.wait(timeout=120) == 0
+    finally:
+        protocol_done.set()
+        for w in workers:
+            if w.poll() is None:
+                w.kill()
+        comm.cleanup()
+
+    cv2 = [jax.tree.map(jnp.asarray, server.final_cvars[r])
+           for r in range(1, len(cb) + 1)]
+    assert_trees_equal(sv1, server.svars, "server vars")
+    assert_trees_equal(cv1, cv2, "client vars")
+    assert l1 == server.losses
+
+
 def test_splitnn_over_grpc():
     """The relay crosses real localhost gRPC sockets (the cross-host
     transport) bit-identically — per-step activations/grads survive actual
