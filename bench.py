@@ -46,8 +46,11 @@ CACHE = Path(__file__).parent / ".bench_cache.json"
 # same host CPU is still a meaningful vs_baseline — and records the fallback
 # reason in extra. Worst case, a machine-readable error JSON line is printed
 # instead of a stack trace so the driver artifact is diagnosable, not null.
+# 2 attempts x 150 s (+10 s backoff) = ~5 min max before the CPU fallback:
+# generous for a healthy-but-slow tunnel init (~1 min), bounded enough that
+# probe + fallback bench stay inside the driver's run budget
 BACKEND_TIMEOUT_S = float(os.environ.get("FEDML_TPU_BENCH_BACKEND_TIMEOUT", 150))
-BACKEND_RETRIES = int(os.environ.get("FEDML_TPU_BENCH_BACKEND_RETRIES", 2))
+BACKEND_RETRIES = int(os.environ.get("FEDML_TPU_BENCH_BACKEND_RETRIES", 1))
 
 
 class BackendUnavailable(RuntimeError):
