@@ -68,8 +68,11 @@ def _probe_backend() -> tuple[str, str | None]:
     """
     import subprocess
 
-    probe_src = "import jax; d = jax.devices()[0]; print('OK', d.device_kind)"
+    probe_src = (
+        "import jax; d = jax.devices()[0]; print('OK', d.platform, d.device_kind)"
+    )
     reason = None
+    probed_ok = False
     for attempt in range(BACKEND_RETRIES + 1):
         try:
             out = subprocess.run(
@@ -80,13 +83,24 @@ def _probe_backend() -> tuple[str, str | None]:
             reason = f"backend probe exceeded {BACKEND_TIMEOUT_S:.0f}s"
         else:
             if out.returncode == 0 and out.stdout.startswith("OK "):
+                platform = out.stdout.split()[1]
+                if platform != "cpu":
+                    probed_ok = True
+                    break
+                # jax answered, but on XLA:CPU: the accelerator plugin is
+                # absent/misconfigured rather than hung. Retrying cannot
+                # change the platform — engage the CPU-fallback path (with
+                # its reduced shape and metric key) instead of mislabeling
+                # a CPU run as TPU.
+                reason = "probe initialized platform 'cpu'"
                 break
             tail = (out.stderr or out.stdout).strip().splitlines()
             reason = tail[-1] if tail else f"probe rc={out.returncode}"
         if attempt < BACKEND_RETRIES:
             time.sleep(10.0 * (attempt + 1))
-    else:
-        # chip never answered: force CPU before jax is first imported here
+    if not probed_ok:
+        # chip never answered (or only CPU came up): force CPU before jax
+        # is first imported here
         os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
@@ -317,6 +331,48 @@ def bench_resnet(reduced: bool = False):
             1.0 / sec_per_round_f32, eval_eps, max(trials))
 
 
+def bench_compress_probe():
+    """Uplink-compression probe (fedml_tpu/compress, docs/COMPRESSION.md):
+    topk-1% encode of the bench ResNet-56 variables pytree. The byte counts
+    are static shape/dtype arithmetic; the timing is the jitted encode
+    wall-clock (host fetch of a value plane forces completion — same
+    tunneled-TPU timing caveat as the round benches). Returns
+    (dense_bytes, encoded_bytes, encode_ms)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.compress import make_codec
+    from fedml_tpu.compress.codec import tree_bytes
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.resnet import resnet56
+
+    trainer = ClientTrainer(
+        module=resnet56(class_num=10), optimizer=optax.sgd(0.1), epochs=1
+    )
+    sample = {
+        "x": jnp.zeros((1, 32, 32, 3), jnp.float32),
+        "y": jnp.zeros((1,), jnp.int32),
+        "mask": jnp.ones((1,), jnp.float32),
+    }
+    variables = trainer.init(jax.random.key(0), sample)
+    codec = make_codec("topk", topk_frac=0.01)
+    enc_fn = jax.jit(codec.encode)
+
+    def run():
+        enc = enc_fn(variables, jax.random.key(1))
+        np.asarray(jax.tree_util.tree_leaves(enc.planes["values"])[0])
+        return enc
+
+    run()  # compile
+    t0 = time.perf_counter()
+    enc = run()
+    ms = (time.perf_counter() - t0) * 1e3
+    return tree_bytes(variables), enc.nbytes, ms
+
+
 def bench_conv_probe():
     """Delivered TFLOP/s for MXU-filling conv shapes on the SAME federated
     engine path as the ResNet bench (256-channel 3x3 convs, bf16)."""
@@ -530,6 +586,18 @@ def _main(stage: list):
     else:
         conv_tflops = lm_sec = lm_tflops = mfu = None
 
+    stage[0] = "bench_compress"
+    try:
+        dense_b, enc_b, enc_ms = bench_compress_probe()
+        compress_extra = {
+            "compress_topk1pct_uplink_bytes": enc_b,
+            "compress_dense_bytes": dense_b,
+            "compress_topk1pct_ratio": round(dense_b / enc_b, 1),
+            "compress_encode_ms": round(enc_ms, 1),
+        }
+    except Exception as e:  # the probe must never sink the bench artifact
+        compress_extra = {"compress_error": f"{type(e).__name__}: {e}"}
+
     def rnd(x, n):
         return round(x, n) if x is not None else None
 
@@ -584,6 +652,7 @@ def _main(stage: list):
             "resnet_f32_rounds_per_sec": rnd(rounds_per_sec_f32, 3),
             "eval_examples_per_sec": round(eval_eps, 1),
             "eval_examples_per_sec_best": round(eval_eps_best, 1),
+            **compress_extra,
         },
     }))
 

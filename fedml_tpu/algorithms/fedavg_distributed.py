@@ -325,12 +325,20 @@ class FedAvgClientManager(ClientManager):
         flat_out, _ = pack_pytree(jax.tree.map(np.asarray, new_vars))
         return flat_out
 
+    def _fill_upload(self, out: Message, new_vars, global_vars) -> None:
+        """Upload-payload seam: base sends the dense packed model; the
+        compressed client sends an encoded delta instead (and needs
+        ``global_vars``, the model it trained from, to form it)."""
+        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                       self._encode_model(new_vars))
+
     def _on_sync(self, msg: Message) -> None:
         if msg.get("finished"):
             self.finish()
             return
         variables = self._decode_model(msg)
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
+        self._client_idx = client_idx  # which client this round trains as
         batches, weights = stack_cohort(
             self.train_data, np.asarray([client_idx]), self.batch_size,
             rng=np.random.RandomState(1000 + self._round),
@@ -341,12 +349,136 @@ class FedAvgClientManager(ClientManager):
         )
         self._round += 1
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
-        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                       self._encode_model(new_vars))
+        self._fill_upload(out, new_vars, variables)
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weights[0]))
         out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round - 1)
         self.send_message(out)
 
+
+
+# ---------------------------------------------------------------------------
+# Compressed-update protocol variant (fedml_tpu/compress, docs/COMPRESSION.md)
+# ---------------------------------------------------------------------------
+
+
+class CompressedDistAggregator(FedAvgDistAggregator):
+    """Server tally for encoded uploads: stores each client's EncodedUpdate
+    (sparse planes — the whole point: the transport and the tally hold
+    kilobytes, not dense models) and aggregates by streaming every upload
+    into ONE dense f64 accumulator (top-k scatter-adds straight from its
+    index/value planes). Delta-domain codecs add the result onto the current
+    global; the ``none`` codec carries models and reproduces the dense
+    protocol's arithmetic bit-for-bit."""
+
+    def __init__(self, worker_num: int, codec):
+        super().__init__(worker_num)
+        self.codec = codec
+        self.get_global = None  # wired by the server manager (current flat)
+
+    def aggregate(self) -> np.ndarray:
+        from fedml_tpu.compress.aggregate import accumulate_encoded
+
+        with self._lock:
+            got = [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
+            w = np.asarray([self.sample_num_dict[i] for i in got], np.float64)
+            w = w / w.sum()
+            base = np.ascontiguousarray(self.get_global()).view(np.float32)
+            acc = np.zeros(base.size, np.float64)
+            for wi, i in zip(w, got):
+                accumulate_encoded(acc, self.model_dict[i], wi, self.codec)
+            if self.codec.delta_domain:
+                acc += base.astype(np.float64)
+            for i in self.flag_client_model_uploaded_dict:
+                self.flag_client_model_uploaded_dict[i] = False
+            return acc.astype(np.float32).view(np.uint8)
+
+
+class CompressedFedAvgServerManager(FedAvgServerManager):
+    """FedAvg server speaking the encoded-update uplink: dense model down,
+    EncodedUpdate planes up, with bytes-on-wire accounting per round."""
+
+    def __init__(self, *args, codec=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        if codec is None:
+            raise ValueError("CompressedFedAvgServerManager needs a codec")
+        self.codec = codec
+        self.aggregator = CompressedDistAggregator(self.worker_num, codec)
+        self.aggregator.get_global = lambda: self.global_flat
+        from fedml_tpu.obs.metrics import CommBytesAccountant
+
+        self.accountant = CommBytesAccountant()
+
+    def _model_payload(self, rank: int):
+        flat = super()._model_payload(rank)
+        self.accountant.record_downlink(len(flat), len(flat))
+        return flat
+
+    def _decode_upload(self, msg: Message):
+        from fedml_tpu.comm.message import unpack_encoded_update
+
+        flat = np.asarray(msg.get(Message.MSG_ARG_KEY_ENCODED_UPDATE))
+        desc = msg.get(Message.MSG_ARG_KEY_ENCODED_DESC)
+        self.accountant.record_uplink(flat.size + len(desc),
+                                      len(self.global_flat))
+        return unpack_encoded_update(flat, desc)
+
+
+class CompressedFedAvgClientManager(FedAvgClientManager):
+    """FedAvg client that uplinks an encoded update instead of the dense
+    model: delta-domain codecs encode (local - global) with error-feedback
+    residual carryover; the ``none`` codec encodes the model itself so the
+    wire path stays bit-identical to the dense protocol.
+
+    EF residuals are keyed by the *assigned client index*, never by worker:
+    at full participation (cohort == arange) that is exact per-client EF;
+    under resampling a client's residual is carried by the last worker that
+    trained it and rejoins when that worker redraws the client — dropped
+    mass from one client is never added into another's update."""
+
+    def __init__(self, comm: BaseCommunicationManager, rank: int, size: int,
+                 trainer: ClientTrainer, train_data: FederatedArrays,
+                 batch_size: int, template_variables: Any,
+                 local_train_fn=None, codec=None, error_feedback: bool = True):
+        super().__init__(comm, rank, size, trainer, train_data, batch_size,
+                         template_variables, local_train_fn=local_train_fn)
+        if codec is None:
+            raise ValueError("CompressedFedAvgClientManager needs a codec")
+        from functools import partial
+
+        from fedml_tpu.compress import error_feedback as eflib
+
+        self.codec = codec
+        self.error_feedback = bool(error_feedback) and codec.delta_domain
+        self._residuals: dict[int, Any] = {}
+        self._encode_ef = jax.jit(partial(eflib.encode_with_feedback, codec))
+        self._encode_plain = jax.jit(codec.encode)
+
+    def _fill_upload(self, out: Message, new_vars, global_vars) -> None:
+        from fedml_tpu.comm.message import pack_encoded_update
+        from fedml_tpu.compress import error_feedback as eflib
+        from fedml_tpu.core import tree as treelib
+
+        key = jax.random.fold_in(
+            jax.random.key(0xC0DEC ^ self.rank), self._round
+        )
+        if self.codec.delta_domain:
+            delta = treelib.tree_sub(new_vars, global_vars)
+            if self.error_feedback:
+                comp = eflib.compensate(
+                    delta, self._residuals.get(self._client_idx)
+                )
+                enc, _, new_residual = self._encode_ef(comp, key)
+                self._residuals[self._client_idx] = new_residual
+            else:
+                # skip the EF program entirely: its jitted outputs include a
+                # dense decode + residual that XLA cannot DCE, all shipped
+                # to host just to be discarded
+                enc = self._encode_plain(delta, key)
+        else:
+            enc = self._encode_plain(new_vars, key)
+        flat, desc = pack_encoded_update(enc)
+        out.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE, flat)
+        out.add_params(Message.MSG_ARG_KEY_ENCODED_DESC, desc)
 
 
 def init_template(trainer: ClientTrainer, train_arrays: dict, batch_size: int,
@@ -397,6 +529,9 @@ def run_distributed_fedavg(
     server_cls: type[FedAvgServerManager] = None,
     server_kwargs: dict | None = None,
     client_cls_for_rank: Callable[[int], type] | None = None,
+    codec=None,
+    error_feedback: bool = True,
+    comm_stats: dict | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -405,14 +540,40 @@ def run_distributed_fedavg(
     same managers drive separate processes when the transport spans them.
     ``server_cls``/``server_kwargs``/``client_cls_for_rank`` swap in
     protocol variants (e.g. fedavg_mobile's JSON-wire managers) without
-    duplicating this harness. Returns the final global variables."""
+    duplicating this harness. ``codec`` switches the uplink to the
+    compressed-update protocol (compress/codec.py; ``error_feedback``
+    toggles per-worker residual carryover, ``comm_stats`` — a caller dict —
+    receives per-round and total bytes-on-wire records). Returns the final
+    global variables."""
+    if codec is not None and (server_cls is not None
+                              or client_cls_for_rank is not None):
+        raise ValueError(
+            "codec= does not compose with custom manager classes "
+            "(e.g. is_mobile's JSON wire format)"
+        )
     template, flat, desc = init_template(trainer, train_data.arrays, batch_size,
                                          seed, init_overrides=init_overrides)
+    if codec is not None:
+        server_cls = CompressedFedAvgServerManager
+        server_kwargs = {**(server_kwargs or {}), "codec": codec}
+
+        def client_cls_for_rank(rank):
+            def make(comm, r, size, tr, data, bs, tmpl):
+                return CompressedFedAvgClientManager(
+                    comm, r, size, tr, data, bs, tmpl,
+                    codec=codec, error_feedback=error_feedback,
+                )
+
+            return make
 
     results: dict[str, np.ndarray] = {}
 
     def _done(r, f):
         results["final"] = f
+        if codec is not None and comm_stats is not None:
+            comm_stats.setdefault("rounds", []).append(
+                server.accountant.round_record(r)
+            )
         if on_round_done is not None:
             on_round_done(r, unpack_pytree(f, desc))
 
@@ -433,6 +594,8 @@ def run_distributed_fedavg(
     ]
 
     run_manager_protocol(server, clients)
+    if codec is not None and comm_stats is not None:
+        comm_stats["totals"] = server.accountant.totals()
     return unpack_pytree(results["final"], desc)
 
 

@@ -60,6 +60,11 @@ class GKTServerManager(ServerManager):
                  n_clients: int, rounds: int, server_epochs: int,
                  rng: jax.Array, cvars0: Pytree, svars: Pytree):
         super().__init__(comm, rank=0, size=n_clients + 1)
+        # send_init_msg unconditionally starts round 0, so rounds=0 would
+        # still run one full round — reject it up front (same contract as
+        # repro_ceilings.centralized_ceiling)
+        if rounds < 1:
+            raise ValueError(f"FedGKT needs rounds >= 1, got {rounds}")
         self.gkt = gkt
         self.n_clients = n_clients
         self.rounds = rounds

@@ -129,6 +129,11 @@ class SplitNNServerManager(ServerManager):
                  n_clients: int, epochs: int, rng: jax.Array,
                  cvars0: Pytree, svars: Pytree):
         super().__init__(comm, rank=0, size=n_clients + 1)
+        # send_init_msg unconditionally starts the first relay turn, so an
+        # empty schedule would still run one full turn — reject it up front
+        # (same contract as repro_ceilings.centralized_ceiling)
+        if epochs < 1:
+            raise ValueError(f"SplitNN relay needs epochs >= 1, got {epochs}")
         self.split = split
         self.n_clients = n_clients
         self.total_turns = epochs * n_clients

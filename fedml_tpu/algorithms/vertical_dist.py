@@ -121,9 +121,15 @@ class VFLGuestManager(ServerManager):
         self.g_opt_state = vfl.optimizer.init(self.gvars["params"])
         self.features = features
         self.y = y
+        # send_init_msg unconditionally announces step 0, so an empty
+        # schedule would IndexError — reject it up front (same contract as
+        # repro_ceilings.centralized_ceiling)
+        if epochs < 1:
+            raise ValueError(f"vertical FL needs epochs >= 1, got {epochs}")
         self.schedule = _step_schedule(len(y), batch_size, epochs)
         self.step = 0
         self._step_logits: dict[int, jnp.ndarray] = {}
+        self._host_acked: dict[int, int] = {}  # last step accepted per host
         self._my_logit: jnp.ndarray | None = None
         self.losses: list[float] = []
         self.final_pvars: dict[int, Pytree] = {}
@@ -157,9 +163,30 @@ class VFLGuestManager(ServerManager):
         self._maybe_complete_step()
 
     def _on_logits(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
         if int(msg.get(VFLMsg.KEY_STEP)) != self.step:
-            return  # stale (cannot happen on FIFO transports; guards WAN reorder)
-        self._step_logits[msg.get_sender_id()] = jnp.asarray(
+            # stale (cannot happen on FIFO transports; guards WAN reorder).
+            # Silently dropping it would deadlock: the host thinks it
+            # answered and is never re-asked. Re-announce the CURRENT step
+            # to that host so it recomputes (TurboAggregate's
+            # resend-on-mismatch pattern); recomputing from current vars is
+            # idempotent — the guest overwrites, never double-counts. But a
+            # stale message stamped at or below the sender's last ACCEPTED
+            # step is a late duplicate of an answer already consumed (the
+            # tail a resend itself produces when its extra reply lands after
+            # the step advanced) — resending on those would echo one
+            # duplicate into an extra (resend, late-reply) pair every step
+            # to schedule end, so those are dropped.
+            if (self.step < len(self.schedule)
+                    and sender not in self._step_logits
+                    and int(msg.get(VFLMsg.KEY_STEP))
+                    > self._host_acked.get(sender, -1)):
+                resend = Message(VFLMsg.MSG_TYPE_G2H_STEP, 0, sender)
+                resend.add_params(VFLMsg.KEY_STEP, self.step)
+                self.send_message(resend)
+            return
+        self._host_acked[sender] = self.step
+        self._step_logits[sender] = jnp.asarray(
             msg.get(VFLMsg.KEY_LOGITS)
         )
         self._maybe_complete_step()

@@ -32,6 +32,10 @@ class Message:
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
     MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    # compressed-update payload (compress/codec.py EncodedUpdate): the flat
+    # byte vector of all encoded planes + the recursive structure descriptor
+    MSG_ARG_KEY_ENCODED_UPDATE = "encoded_update"
+    MSG_ARG_KEY_ENCODED_DESC = "encoded_desc"
 
     def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {
@@ -136,6 +140,60 @@ def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
     else:
         flat = np.zeros((0,), np.uint8)
     return flat, json.dumps(desc)
+
+
+def pack_encoded_update(enc) -> tuple[np.ndarray, str]:
+    """Flatten a (possibly chain-nested) ``EncodedUpdate`` to (flat byte
+    vector, json descriptor) — the encoded-update payload type. Each plane is
+    packed with :func:`pack_pytree` (native dtypes bit-exact: bf16 values,
+    int32 indices, packed-nibble uint8 all survive untouched); the descriptor
+    records scheme/meta and per-plane pack descriptors recursively, so the
+    receiver rebuilds the exact EncodedUpdate without densifying anything."""
+    from fedml_tpu.compress.codec import EncodedUpdate
+
+    segs: list[np.ndarray] = []
+
+    def walk(e) -> dict:
+        spec: dict[str, Any] = {"scheme": e.scheme, "meta": e.meta, "planes": {}}
+        for name in sorted(e.planes):
+            v = e.planes[name]
+            if isinstance(v, EncodedUpdate):
+                spec["planes"][name] = {"__enc__": walk(v)}
+            else:
+                flat, desc = pack_pytree(jax.tree.map(np.asarray, v))
+                segs.append(flat)
+                spec["planes"][name] = {"__tree__": json.loads(desc),
+                                        "nbytes": int(flat.size)}
+        return spec
+
+    spec = walk(enc)
+    flat = np.concatenate(segs) if segs else np.zeros((0,), np.uint8)
+    return flat, json.dumps(spec)
+
+
+def unpack_encoded_update(flat: np.ndarray, descriptor: str):
+    """Inverse of :func:`pack_encoded_update`."""
+    from fedml_tpu.compress.codec import EncodedUpdate
+
+    flat = np.asarray(flat, dtype=np.uint8)
+    offset = 0
+
+    def walk(spec: dict):
+        nonlocal offset
+        planes = {}
+        for name in sorted(spec["planes"]):
+            p = spec["planes"][name]
+            if "__enc__" in p:
+                planes[name] = walk(p["__enc__"])
+            else:
+                n = int(p["nbytes"])
+                planes[name] = unpack_pytree(
+                    flat[offset : offset + n], json.dumps(p["__tree__"])
+                )
+                offset += n
+        return EncodedUpdate(spec["scheme"], planes, spec["meta"])
+
+    return walk(json.loads(descriptor))
 
 
 def unpack_pytree(flat: np.ndarray, descriptor: str) -> Any:

@@ -1,0 +1,26 @@
+"""Update-compression subsystem: codecs, error feedback, wire + engine
+integration, and bytes-on-wire accounting (docs/COMPRESSION.md)."""
+
+from fedml_tpu.compress.codec import (
+    Bf16Codec,
+    ChainCodec,
+    Codec,
+    EncodedUpdate,
+    NoneCodec,
+    QuantizeCodec,
+    TopKCodec,
+    make_codec,
+    tree_bytes,
+)
+
+__all__ = [
+    "Bf16Codec",
+    "ChainCodec",
+    "Codec",
+    "EncodedUpdate",
+    "NoneCodec",
+    "QuantizeCodec",
+    "TopKCodec",
+    "make_codec",
+    "tree_bytes",
+]

@@ -1,0 +1,151 @@
+"""Compression-aware aggregation.
+
+Two consumers:
+
+- The **sim engine** (`sim/engine.py`): :func:`compressed_aggregator` wraps
+  any broadcast-mode server rule (FedAvg / FedOpt / FedNova / robust) so the
+  round program encodes each client's delta (with optional error feedback),
+  decodes, and hands the inner rule the *reconstructed* stack — compression
+  becomes a pure transform on the stacked-client axis, and the per-round
+  bytes-on-wire metrics ride the ordinary agg-metrics channel into the
+  metrics stream.
+
+- The **message-passing server** (`algorithms/fedavg_distributed.py`):
+  :func:`accumulate_encoded` folds one client's encoded delta into a single
+  dense f64 accumulator — top-k planes scatter-add directly from their
+  (index, value) planes, so the server never materializes per-client dense
+  trees; dense-plane codecs stream one transient decode at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
+from fedml_tpu.compress import error_feedback as ef
+from fedml_tpu.compress.codec import Codec, EncodedUpdate, tree_bytes
+from fedml_tpu.obs import metrics as metricslib
+
+Pytree = Any
+
+
+def compressed_aggregator(
+    codec: Codec,
+    inner: Aggregator | None = None,
+    error_feedback: bool = True,
+    num_slots: int | None = None,
+) -> Aggregator:
+    """Wrap ``inner`` so client updates pass through ``codec`` (+EF) first.
+
+    ``num_slots`` is the padded cohort size the engine stages ([C_pad]); the
+    EF residual stack is [num_slots, ...] and is matched to clients by slot,
+    which is identity exactly when the cohort is the full population
+    (rng.sample_clients returns ``arange`` at full participation) — the
+    engine enforces that precondition. Padding slots train fully-masked
+    (zero delta) so their residuals stay zero.
+    """
+    inner = inner or fedavg_aggregator()
+    if getattr(inner, "per_client", False):
+        raise NotImplementedError(
+            "update compression wraps broadcast-mode aggregators; per-client "
+            f"rules ({inner.name}) keep models resident and have no uplink "
+            "delta to compress"
+        )
+    if error_feedback and num_slots is None:
+        raise ValueError("error_feedback=True needs num_slots (padded cohort)")
+
+    def init_state(global_variables):
+        res = ()
+        if error_feedback:
+            res = jax.tree.map(
+                lambda l: jnp.zeros((num_slots,) + np.shape(l), jnp.result_type(l)),
+                global_variables,
+            )
+        return {"inner": inner.init_state(global_variables), "residual": res}
+
+    def aggregate(global_variables, stacked, weights, state, rng, extras=None):
+        c = weights.shape[0]
+        delta = jax.tree.map(lambda s, g: s - g[None].astype(s.dtype),
+                             stacked, global_variables)
+        comp = delta
+        if error_feedback:
+            comp = jax.tree.map(jnp.add, delta, state["residual"])
+        keys = jax.random.split(jax.random.fold_in(rng, 0xC0DEC), c)
+        enc, dec, new_res = jax.vmap(
+            lambda t, k: ef.encode_with_feedback(codec, t, k)
+        )(comp, keys)
+        reconstructed = jax.tree.map(
+            lambda g, d: (g[None] + d.astype(jnp.result_type(g))).astype(
+                jnp.result_type(g)
+            ),
+            global_variables, dec,
+        )
+        new_global, inner_state, inner_metrics = inner.aggregate(
+            global_variables, reconstructed, weights, state["inner"], rng, extras
+        )
+        # Byte accounting is static (shapes/dtypes only): per-client encoded
+        # bytes come out of the vmapped planes' [C, ...] leaves; only the
+        # non-padding cohort (weight > 0) actually crosses the wire.
+        per_client = enc.nbytes / c
+        dense = float(tree_bytes(global_variables))
+        real = jnp.sum((weights > 0).astype(jnp.float32))
+        metrics = {
+            metricslib.COMM_UPLINK_BYTES: real * per_client,
+            metricslib.COMM_UPLINK_DENSE_BYTES: real * dense,
+            metricslib.COMM_DOWNLINK_BYTES: real * dense,
+            metricslib.COMM_DOWNLINK_DENSE_BYTES: real * dense,
+            metricslib.COMM_RATIO: jnp.float32(dense / per_client),
+        }
+        new_state = {
+            "inner": inner_state,
+            "residual": new_res if error_feedback else (),
+        }
+        return new_global, new_state, {**inner_metrics, **metrics}
+
+    return Aggregator(
+        init_state, aggregate, name=f"compressed[{codec.name}]>{inner.name}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Host-side streaming accumulation for the message-passing server
+# ---------------------------------------------------------------------------
+
+
+def _flat_leaves(tree: Pytree) -> list[np.ndarray]:
+    from fedml_tpu.core.tree import tree_leaves_with_paths
+
+    return [np.ravel(np.asarray(v)) for _, v in tree_leaves_with_paths(tree)]
+
+
+def accumulate_encoded(
+    acc: np.ndarray, enc: EncodedUpdate, weight: float, codec: Codec
+) -> None:
+    """``acc += weight * decode(enc)`` into a flat f64 accumulator laid out in
+    canonical leaf order (the ``pack_pytree`` wire layout).
+
+    Plain top-k updates scatter-add straight from their int32/bf16 planes —
+    O(k) work and no dense materialization per client. Other schemes decode
+    one client at a time (one transient dense vector, never C of them).
+    """
+    if enc.scheme == "topk" and not isinstance(
+        enc.planes.get("values"), EncodedUpdate
+    ):
+        vals = _flat_leaves(enc.planes["values"])
+        idxs = _flat_leaves(enc.planes["indices"])
+        off = 0
+        for v, idx, spec in zip(vals, idxs, enc.meta_dict()["leaves"]):
+            n = int(np.prod(spec["shape"])) if spec["shape"] else 1
+            np.add.at(acc, off + idx.astype(np.int64),
+                      weight * v.astype(np.float64))
+            off += n
+        return
+    dense = _flat_leaves(codec.decode(enc))
+    off = 0
+    for leaf in dense:
+        acc[off : off + leaf.size] += weight * leaf.astype(np.float64)
+        off += leaf.size

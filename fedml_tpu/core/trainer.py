@@ -312,6 +312,42 @@ def make_local_train(trainer: ClientTrainer):
     return local_train
 
 
+def make_local_update(trainer: ClientTrainer, codec=None, local_train_fn=None):
+    """Compressed local-update program: ``local_update(global_variables,
+    data, rng, residual=None, num_steps=None) -> (payload, new_residual,
+    metrics)``.
+
+    Runs :func:`make_local_train`, takes the model delta, adds the carried
+    error-feedback ``residual`` (compress/error_feedback.py), and encodes it
+    with ``codec`` (compress/codec.py) — the client side of the
+    update-compression subsystem in one jit-compatible function.
+    ``codec=None`` returns the raw delta (``payload`` is a pytree);
+    otherwise ``payload`` is an ``EncodedUpdate`` and ``metrics`` gains
+    ``uplink_bytes``/``uplink_dense_bytes``.
+    """
+    from fedml_tpu.compress import error_feedback as ef
+    from fedml_tpu.compress.codec import tree_bytes
+    from fedml_tpu.core import tree as treelib
+
+    local_train = local_train_fn or make_local_train(trainer)
+
+    def local_update(global_variables, data, rng, residual=None, num_steps=None):
+        new_vars, metrics = local_train(global_variables, data, rng, num_steps)
+        delta = treelib.tree_sub(new_vars, global_variables)
+        if codec is None:
+            return delta, residual, metrics
+        comp = ef.compensate(delta, residual)
+        enc, _, new_residual = ef.encode_with_feedback(
+            codec, comp, jax.random.fold_in(rng, 0xC0DEC)
+        )
+        metrics = dict(metrics)
+        metrics["uplink_bytes"] = jnp.float32(enc.nbytes)
+        metrics["uplink_dense_bytes"] = jnp.float32(tree_bytes(delta))
+        return enc, new_residual, metrics
+
+    return local_update
+
+
 def make_local_eval(trainer: ClientTrainer):
     """``local_eval(variables, data) -> summed metric dict`` over [S, B, ...]
     batches; vmap over clients for the all-client eval the reference does

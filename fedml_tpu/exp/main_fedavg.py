@@ -90,6 +90,24 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--norm_bound", type=float, default=0.0)
     parser.add_argument("--stddev", type=float, default=0.0)
     parser.add_argument("--robust_rule", type=str, default="mean")
+    # update compression (fedml_tpu/compress, docs/COMPRESSION.md)
+    parser.add_argument("--compressor", type=str, default="none",
+                        help="client->server update codec: none | bf16 | "
+                             "topk | q8 | q4, composable with '+' "
+                             "(e.g. topk+q4). 'none' keeps the dense "
+                             "bit-identical path. Works on --backend sim "
+                             "and the message-passing backends; round "
+                             "metrics gain Comm/* bytes-on-wire keys")
+    parser.add_argument("--topk-frac", "--topk_frac", dest="topk_frac",
+                        type=float, default=0.01,
+                        help="fraction of entries the topk codec keeps "
+                             "per leaf")
+    parser.add_argument("--quantize_bits", type=int, default=8,
+                        choices=[4, 8],
+                        help="bit width for the quantize/q* codecs")
+    parser.add_argument("--error_feedback", type=int, default=1,
+                        help="carry the codec's dropped mass into the next "
+                             "round's update (EF-SGD residual)")
     # engine knobs
     parser.add_argument("--model_dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"],
@@ -242,6 +260,13 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
 
     def on_round(r, variables):
         rec = {"round": r}
+        # the server's accountant flushes the round's Comm/* record into
+        # comm_stats just before this callback fires (fedavg_distributed
+        # _done), so bytes-on-wire land in the same metrics stream as
+        # Test/Acc
+        for crec in comm_stats.get("rounds", []):
+            if crec.get("round") == r:
+                rec.update({k: v for k, v in crec.items() if k != "round"})
         if ev is not None and (
             (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
         ):
@@ -262,6 +287,22 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             threshold_bytes=args.offload_threshold_bytes,
         ),
     }
+    codec_kwargs = {}
+    comm_stats: dict = {}
+    if getattr(args, "compressor", "none") != "none":
+        if getattr(args, "is_mobile", 0):
+            raise NotImplementedError(
+                "--compressor and --is_mobile both redefine the wire "
+                "format; pick one"
+            )
+        from fedml_tpu.compress import make_codec
+
+        codec_kwargs = {
+            "codec": make_codec(args.compressor, topk_frac=args.topk_frac,
+                                quantize_bits=args.quantize_bits),
+            "error_feedback": bool(args.error_feedback),
+            "comm_stats": comm_stats,
+        }
     overrides = None
     if getattr(args, "init_from", None):
         from fedml_tpu.obs.checkpoint import load_params
@@ -287,7 +328,10 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         on_round_done=on_round,
         init_overrides=overrides,
         **mobile_kwargs,
+        **codec_kwargs,
     )
+    if comm_stats.get("totals"):
+        logging.info("bytes on wire: %s", comm_stats["totals"])
     if getattr(args, "save_params_to", None):
         from fedml_tpu.obs.checkpoint import save_params
 
@@ -342,6 +386,10 @@ def run(args) -> list[dict]:
         eval_on_clients=bool(args.eval_on_clients),
         stage_on_device=(None if args.stage_on_device < 0
                          else bool(args.stage_on_device)),
+        compressor=getattr(args, "compressor", "none"),
+        topk_frac=getattr(args, "topk_frac", 0.01),
+        quantize_bits=getattr(args, "quantize_bits", 8),
+        error_feedback=bool(getattr(args, "error_feedback", 1)),
         profile_dir=args.profile_dir,
     )
 

@@ -18,6 +18,86 @@ from pathlib import Path
 from typing import Any
 
 
+# Canonical bytes-on-wire metric keys (compress subsystem): actual bytes
+# that crossed (or would cross) the transport vs the dense-f32 equivalent,
+# per round. Emitted by the sim engine's compressed aggregator and the
+# message-passing FedAvg server so compression ratio shows up in the same
+# metrics stream as Train/Acc (docs/COMPRESSION.md).
+COMM_UPLINK_BYTES = "Comm/UplinkBytes"
+COMM_UPLINK_DENSE_BYTES = "Comm/UplinkDenseBytes"
+COMM_DOWNLINK_BYTES = "Comm/DownlinkBytes"
+COMM_DOWNLINK_DENSE_BYTES = "Comm/DownlinkDenseBytes"
+COMM_RATIO = "Comm/CompressionRatio"
+
+
+class CommBytesAccountant:
+    """Per-round uplink/downlink byte ledger for the message-passing path.
+
+    The sim engine computes these inside the round program (shapes are
+    static); the wire path counts real payload sizes here instead — one
+    ``record_*`` call per message, ``round_record`` to flush a round's
+    totals into the metrics stream under the canonical keys."""
+
+    def __init__(self):
+        import threading
+
+        # record_* runs on the server's receive thread; round_record can run
+        # on the straggler-timeout timer thread (fedavg_distributed
+        # _round_timed_out -> _complete_round) — counters need the lock or
+        # an interleaved read-add-store loses straggler bytes
+        self._lock = threading.Lock()
+        self.rounds: list[dict] = []
+        self._up = self._up_dense = 0
+        self._down = self._down_dense = 0
+
+    def record_uplink(self, actual: int, dense: int) -> None:
+        with self._lock:
+            self._up += int(actual)
+            self._up_dense += int(dense)
+
+    def record_downlink(self, actual: int, dense: int) -> None:
+        with self._lock:
+            self._down += int(actual)
+            self._down_dense += int(dense)
+
+    def round_record(self, round_idx: int) -> dict:
+        with self._lock:
+            rec = {
+                "round": round_idx,
+                COMM_UPLINK_BYTES: self._up,
+                COMM_UPLINK_DENSE_BYTES: self._up_dense,
+                COMM_DOWNLINK_BYTES: self._down,
+                COMM_DOWNLINK_DENSE_BYTES: self._down_dense,
+            }
+            if self._up:
+                rec[COMM_RATIO] = self._up_dense / self._up
+            self.rounds.append(rec)
+            self._up = self._up_dense = self._down = self._down_dense = 0
+            return rec
+
+    def totals(self) -> dict:
+        out: dict = {}
+        # include traffic recorded since the last round flush (e.g. the
+        # final stop broadcast, which lands after the last round_record)
+        with self._lock:
+            pending = {
+                COMM_UPLINK_BYTES: self._up,
+                COMM_UPLINK_DENSE_BYTES: self._up_dense,
+                COMM_DOWNLINK_BYTES: self._down,
+                COMM_DOWNLINK_DENSE_BYTES: self._down_dense,
+            }
+            rounds = list(self.rounds)
+        for rec in rounds + [pending]:
+            for k, v in rec.items():
+                if k.startswith("Comm/") and k != COMM_RATIO:
+                    out[k] = out.get(k, 0) + v
+        if out.get(COMM_UPLINK_BYTES):
+            out[COMM_RATIO] = (
+                out[COMM_UPLINK_DENSE_BYTES] / out[COMM_UPLINK_BYTES]
+            )
+        return out
+
+
 def logging_config(process_id: int = 0, level=logging.INFO) -> None:
     """Per-process log format (fedml_api/utils/logger.py:7-32)."""
     logging.basicConfig(

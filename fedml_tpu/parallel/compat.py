@@ -1,0 +1,50 @@
+"""JAX API compatibility seams.
+
+The engine targets the current ``jax.shard_map`` API (``axis_names`` names
+the manual axes, ``check_vma`` gates the varying-manual-axes check). Older
+jax (< 0.5) ships the same primitive as ``jax.experimental.shard_map`` with
+the inverse parameterization (``auto`` names the NON-manual axes,
+``check_rep`` gates the replication check). This shim presents the new
+surface on either runtime so every shard_mapped program in the repo compiles
+against whichever jax the container bakes in.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+def shard_map(f, *, mesh, in_specs, out_specs,
+              axis_names: Any = None, check_vma: bool | None = None):
+    """``jax.shard_map`` signature, runnable on old and new jax alike.
+
+    ``axis_names=None`` means manual over every mesh axis (both APIs'
+    default); ``check_vma=None`` keeps the runtime's default check.
+    """
+    if hasattr(jax, "shard_map"):
+        kwargs = {}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # Full-manual always: the legacy lowering's partial-manual mode (auto =
+    # the non-named axes) trips an XLA SPMD partitioner CHECK
+    # (spmd_partitioner.cc "IsManualSubgroup" mismatch → SIGABRT) on real
+    # round programs. Running the would-be-auto axes manual is semantically
+    # identical — the body cannot reference an unnamed axis, so each device
+    # just computes its block's program replicated along those axes — at the
+    # cost of losing auto-sharded data parallelism over them on this
+    # (legacy-jax) runtime only.
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )

@@ -21,7 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
+
+from fedml_tpu.parallel.compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 SP_AXIS = "sp"

@@ -27,6 +27,7 @@ from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
+from fedml_tpu.parallel import compat
 from fedml_tpu.parallel import mesh as meshlib
 from fedml_tpu.sim import cohort as cohortlib
 
@@ -82,6 +83,19 @@ class SimConfig:
     # MXU without cross-client batching, so scan costs ~nothing and frees
     # C_local-1 clients' worth of HBM for longer sequences / bigger batches).
     cohort_execution: str = "vmap"
+    # Update compression (fedml_tpu/compress, docs/COMPRESSION.md): codec
+    # spec for client->server updates — "none" keeps the dense bit-identical
+    # path with no compression machinery in the program; "topk"/"q8"/"q4"/
+    # "bf16" and "+"-chains route every client delta through
+    # encode->decode with optional error feedback, and the round metrics
+    # gain the Comm/* bytes-on-wire keys (obs/metrics.py).
+    compressor: str = "none"
+    topk_frac: float = 0.01
+    quantize_bits: int = 8
+    # Sim-mode error feedback keys residuals by cohort slot, which equals
+    # client identity only at full participation (rng.sample_clients returns
+    # arange there) — enforced at engine construction.
+    error_feedback: bool = True
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
@@ -125,6 +139,30 @@ class FedSim:
             )
         self.aggregator = aggregator or fedavg_aggregator()
         self.mesh = mesh if mesh is not None else meshlib.client_mesh()
+        if config.compressor and config.compressor != "none":
+            from fedml_tpu.compress import make_codec
+            from fedml_tpu.compress.aggregate import compressed_aggregator
+
+            if (config.error_feedback
+                    and config.client_num_per_round != config.client_num_in_total):
+                raise ValueError(
+                    "sim-mode error feedback keys residuals by cohort slot, "
+                    "which matches client identity only at full participation "
+                    f"(got {config.client_num_per_round}/"
+                    f"{config.client_num_in_total} per round); use full "
+                    "participation, error_feedback=False, or a "
+                    "message-passing backend (residuals keyed by assigned "
+                    "client index)"
+                )
+            n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
+            c_pad = -(-config.client_num_per_round // n_dev) * n_dev
+            self.aggregator = compressed_aggregator(
+                make_codec(config.compressor, topk_frac=config.topk_frac,
+                           quantize_bits=config.quantize_bits),
+                inner=self.aggregator,
+                error_feedback=config.error_feedback,
+                num_slots=c_pad,
+            )
         # per-client persistent models (decentralized/gossip FL): each client
         # trains from its own round-(r-1) model instead of a broadcast global
         self._per_client = bool(getattr(self.aggregator, "per_client", False))
@@ -177,8 +215,15 @@ class FedSim:
         # per-client mode: the model state is itself a stacked [C, ...] pytree
         # sharded over the clients axis, in and out of the round program
         var_spec = cohort_spec if self._per_client else P()
+        # Donating the model argument miscompiles under the legacy
+        # jax.experimental.shard_map lowering: aliased outputs read recycled
+        # buffers — deterministically garbage for the per-client stack, and
+        # intermittently corrupted broadcast-mode params under full-suite
+        # memory pressure. Donate only on runtimes with the current
+        # jax.shard_map API.
+        self._donate = (0,) if hasattr(jax, "shard_map") else ()
         self._round_fn = jax.jit(
-            jax.shard_map(
+            compat.shard_map(
                 self._round_impl,
                 mesh=self.mesh,
                 in_specs=(var_spec, P(), cohort_spec, cohort_spec, cohort_spec, P()),
@@ -186,7 +231,7 @@ class FedSim:
                 axis_names=frozenset({meshlib.CLIENT_AXIS}),
                 check_vma=False,
             ),
-            donate_argnums=(0,),
+            donate_argnums=self._donate,
         )
         self._eval_fn = jax.jit(self._eval_impl) if self._can_eval else None
 
@@ -211,7 +256,7 @@ class FedSim:
                 self._rep,
             )
             self._gather_round_fn = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     self._gather_round_impl,
                     mesh=self.mesh,
                     in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
@@ -220,7 +265,7 @@ class FedSim:
                     axis_names=frozenset({meshlib.CLIENT_AXIS}),
                     check_vma=False,
                 ),
-                donate_argnums=(0,),
+                donate_argnums=self._donate,
             )
 
         self._test_batches = None
@@ -421,7 +466,7 @@ class FedSim:
                 P(meshlib.CLIENT_AXIS) if self._per_client else P()
             )
             self._block_fns[n_rounds] = jax.jit(
-                jax.shard_map(
+                compat.shard_map(
                     self._block_impl,
                     mesh=self.mesh,
                     in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
@@ -430,7 +475,7 @@ class FedSim:
                     axis_names=frozenset({meshlib.CLIENT_AXIS}),
                     check_vma=False,
                 ),
-                donate_argnums=(0,),
+                donate_argnums=self._donate,
             )
         return self._block_fns[n_rounds]
 

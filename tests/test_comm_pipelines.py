@@ -262,6 +262,63 @@ def test_vfl_loopback_matches_stepwise():
     assert l1 == l2
 
 
+def test_vfl_stale_logits_resend_guard():
+    """A stale H2G logits message re-announces the current step to that host
+    (a non-FIFO transport can reorder the announcement past the reply, which
+    would deadlock if silently dropped) — but only while that host's
+    current-step answer is outstanding; a late duplicate after it answered
+    must be dropped, or each resend's extra reply arrives one step late and
+    echoes another resend until the schedule ends."""
+    from fedml_tpu.algorithms.vertical_dist import VFLGuestManager, VFLMsg
+    from fedml_tpu.comm.message import Message
+
+    class _RecordingComm:
+        def __init__(self):
+            self.sent = []
+
+        def add_observer(self, obs):
+            pass
+
+        def send_message(self, msg):
+            self.sent.append(msg)
+
+    vfl, fs, y = _vfl_setup()
+    comm = _RecordingComm()
+    guest = VFLGuestManager(comm, vfl, vfl.init(jax.random.key(0), fs),
+                            fs[0], y, batch_size=40, epochs=1)
+
+    def h2g(host, step):
+        msg = Message(VFLMsg.MSG_TYPE_H2G_LOGITS, host, 0)
+        msg.add_params(VFLMsg.KEY_STEP, step)
+        msg.add_params(VFLMsg.KEY_LOGITS, np.zeros((40, 2), np.float32))
+        return msg
+
+    # host 2's answer for the current step is outstanding: re-announce once
+    guest._on_logits(h2g(2, guest.step + 5))
+    assert len(comm.sent) == 1
+    assert comm.sent[0].get_receiver_id() == 2
+    assert int(comm.sent[0].get(VFLMsg.KEY_STEP)) == guest.step
+
+    # after host 2 answers the current step, a late duplicate is dropped
+    guest._on_logits(h2g(2, guest.step))
+    guest._on_logits(h2g(2, guest.step + 5))
+    assert len(comm.sent) == 1
+
+    # a duplicate landing AFTER the step advanced (the echo tail a resend's
+    # extra reply produces) is also dropped: host 2 acked this step already
+    answered = guest.step
+    guest.step += 1
+    guest._step_logits = {}
+    guest._on_logits(h2g(2, answered))
+    assert len(comm.sent) == 1
+    # ...while a never-accepted stale answer (host 1 lost the announcement)
+    # still triggers the deadlock-breaking re-announce
+    guest._on_logits(h2g(1, answered))
+    assert len(comm.sent) == 2
+    assert comm.sent[1].get_receiver_id() == 1
+    assert int(comm.sent[1].get(VFLMsg.KEY_STEP)) == guest.step
+
+
 def _gkt_setup(n_clients=2, S=2, B=8):
     train, _ = gaussian_blobs(
         n_clients=n_clients, samples_per_client=S * B, num_classes=4, seed=1

@@ -1,16 +1,21 @@
-"""Docs stay honest: every ``fedml_tpu.*`` dotted name cited in
-docs/MIGRATION.md must import (modules) and resolve (attributes)."""
+"""Docs stay honest: every ``fedml_tpu.*`` dotted name cited in the docs
+pages must import (modules) and resolve (attributes), and every cited CLI
+entry must exist."""
 
 import importlib
 import re
 from pathlib import Path
 
-DOC = Path(__file__).parent.parent / "docs" / "MIGRATION.md"
+import pytest
+
+DOCS_DIR = Path(__file__).parent.parent / "docs"
+DOCS = [DOCS_DIR / "MIGRATION.md", DOCS_DIR / "COMPRESSION.md"]
 
 
-def test_migration_doc_names_resolve():
-    names = set(re.findall(r"`(fedml_tpu(?:\.\w+)+)`", DOC.read_text()))
-    assert names, "MIGRATION.md should cite fedml_tpu APIs"
+@pytest.mark.parametrize("doc", DOCS, ids=lambda p: p.name)
+def test_doc_names_resolve(doc):
+    names = set(re.findall(r"`(fedml_tpu(?:\.\w+)+)`", doc.read_text()))
+    assert names, f"{doc.name} should cite fedml_tpu APIs"
     failures = []
     for name in sorted(names):
         parts = name.split(".")
@@ -32,11 +37,22 @@ def test_migration_doc_names_resolve():
     assert not failures, failures
 
 
-def test_migration_doc_cli_entries_exist():
-    """Every ``python -m fedml_tpu.exp.X`` command in the doc has a module
+@pytest.mark.parametrize("doc", DOCS, ids=lambda p: p.name)
+def test_doc_cli_entries_exist(doc):
+    """Every ``python -m fedml_tpu.exp.X`` command in a doc has a module
     with a main()."""
-    mods = set(re.findall(r"python -m (fedml_tpu\.exp\.\w+)", DOC.read_text()))
-    assert mods
+    mods = set(re.findall(r"python -m (fedml_tpu\.exp\.\w+)", doc.read_text()))
+    if doc.name == "MIGRATION.md":
+        assert mods
     for mod in sorted(mods):
         m = importlib.import_module(mod)
         assert hasattr(m, "main"), mod
+
+
+def test_compression_doc_tools_exist():
+    """The smoke script the doc points at is runnable (has a main)."""
+    text = (DOCS_DIR / "COMPRESSION.md").read_text()
+    for rel in set(re.findall(r"tools/\w+\.py", text)):
+        path = DOCS_DIR.parent / rel
+        assert path.exists(), rel
+        assert "def main" in path.read_text(), rel

@@ -8,8 +8,9 @@ import numpy as np
 import optax
 import pytest
 from functools import partial
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.ops.attention import attention_reference, flash_attention
 from fedml_tpu.parallel.ring_attention import ring_attention

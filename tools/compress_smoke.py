@@ -1,0 +1,61 @@
+"""Compression smoke: encode one synthetic MLP update with every codec and
+print bytes / ratio — the zero-setup look at what `--compressor` buys
+(docs/COMPRESSION.md). Runs anywhere:
+
+    JAX_PLATFORMS=cpu python tools/compress_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SPECS = ("none", "bf16", "topk", "q8", "q4", "topk+q4")
+
+
+def synthetic_mlp_update(seed: int = 0, dim: int = 256, hidden: int = 512,
+                         classes: int = 10):
+    """A gradient-shaped pytree: most mass in a few coordinates (the regime
+    top-k exploits), realistic MLP layer shapes."""
+    rng = np.random.RandomState(seed)
+
+    def leaf(*shape):
+        x = rng.laplace(0.0, 0.01, shape).astype(np.float32)
+        return jnp.asarray(x)
+
+    return {
+        "params": {
+            "Dense_0": {"kernel": leaf(dim, hidden), "bias": leaf(hidden)},
+            "Dense_1": {"kernel": leaf(hidden, classes), "bias": leaf(classes)},
+        }
+    }
+
+
+def main(argv=None) -> int:
+    from fedml_tpu.comm.message import pack_encoded_update
+    from fedml_tpu.compress import make_codec
+    from fedml_tpu.compress.codec import tree_bytes
+
+    update = synthetic_mlp_update()
+    dense = tree_bytes(update)
+    n_params = sum(x.size for x in jax.tree_util.tree_leaves(update))
+    print(f"synthetic MLP update: {n_params:,} params, {dense:,} dense bytes")
+    print(f"{'codec':>10} {'planes B':>12} {'wire B':>12} {'ratio':>8}")
+    for spec in SPECS:
+        codec = make_codec(spec, topk_frac=0.01, quantize_bits=8)
+        enc = jax.jit(codec.encode)(update, jax.random.key(1))
+        flat, desc = pack_encoded_update(enc)
+        wire = flat.size + len(desc)  # what actually crosses the transport
+        print(f"{spec:>10} {enc.nbytes:>12,} {wire:>12,} {dense / wire:>8.1f}x")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
