@@ -233,8 +233,80 @@ def _measure_rounds(sim, n_meas: int = 5, block: int = 1) -> float:
     return (time.perf_counter() - t0) / (n_meas * block)
 
 
+STAGE_CLIENTS = 256  # the staging probe's synthetic cohort size
+
+
+def bench_stage_probe():
+    """Host staging cost per round at population scale: the vectorized
+    cohort builder (sim/cohort.cohort_index_map) vs the pre-PR per-client
+    Python loop, on a 256-client cohort with per-round shuffling. Pure host
+    numpy — meaningful on any backend, and exactly what the pipelined
+    driver's prefetch thread runs per round. Returns
+    (host_stage_ms, host_stage_ms_loop)."""
+    import numpy as np
+
+    from fedml_tpu.sim.cohort import (
+        FederatedArrays,
+        _cohort_index_map_loop,
+        cohort_index_map,
+    )
+
+    n_per = 64
+    C = STAGE_CLIENTS
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(C)}
+    data = FederatedArrays(
+        {"x": np.zeros((C * n_per, 8), np.float32),
+         "y": np.zeros(C * n_per, np.int32)},
+        part,
+    )
+    cohort = np.arange(C)
+    data.index_csr()  # one-time cache build stays out of the per-round cost
+    reps = 20
+
+    def per_round_ms(fn):
+        # best of 3 windows: host microbenchmark, so take the least
+        # load-disturbed window rather than averaging scheduler noise in
+        fn(data, cohort, 32, rng=np.random.RandomState(0))  # warm
+        best = float("inf")
+        for _trial in range(3):
+            t0 = time.perf_counter()
+            for rep in range(reps):
+                fn(data, cohort, 32, rng=np.random.RandomState(rep))
+            best = min(best, (time.perf_counter() - t0) / reps * 1e3)
+        return best
+
+    return per_round_ms(cohort_index_map), per_round_ms(_cohort_index_map_loop)
+
+
+def bench_pipeline_ab(trainer, train, test, cfg, n_rounds: int):
+    """A-B probe for the pipelined round driver: rounds/sec through
+    FedSim.run() with the pipeline on (default double-buffered prefetch +
+    metrics drain) vs off (serial stage->dispatch->fetch). Single-round
+    dispatch (block_dispatch=False) — the path where per-round host staging
+    actually sits between device programs. Both arms share one compiled
+    program; each arm runs once to warm, once measured."""
+    import dataclasses
+
+    from fedml_tpu.sim.engine import FedSim
+
+    cfg = dataclasses.replace(
+        cfg, comm_round=n_rounds, frequency_of_the_test=10_000,
+        block_dispatch=False,
+    )
+
+    def rps(depth):
+        sim = FedSim(trainer, train, test, dataclasses.replace(cfg, pipeline_depth=depth))
+        sim.run()  # compile + warm
+        t0 = time.perf_counter()
+        _, hist = sim.run()
+        return len(hist) / (time.perf_counter() - t0)
+
+    return rps(None), rps(0)
+
+
 def bench_resnet(reduced: bool = False):
-    """(rounds/sec, eval examples/sec) for the primary ResNet-56 config.
+    """(rounds/sec, eval examples/sec, pipeline extras) for the primary
+    ResNet-56 config.
 
     ``reduced`` (the XLA:CPU fallback) keeps the model and the primary
     block-dispatch metric but drops the f32/single-dispatch secondaries and
@@ -293,7 +365,12 @@ def bench_resnet(reduced: bool = False):
         t0 = time.perf_counter()
         sim.evaluate(variables)
         eval_eps = (n + n_eval) / (time.perf_counter() - t0)
-        return 1.0 / sec_per_round, None, None, eval_eps, eval_eps
+        pipe_on, pipe_off = bench_pipeline_ab(trainer, train, test, cfg, 3)
+        pipeline_extra = {
+            "pipeline_on_rounds_per_sec": round(pipe_on, 3),
+            "pipeline_off_rounds_per_sec": round(pipe_off, 3),
+        }
+        return 1.0 / sec_per_round, None, None, eval_eps, eval_eps, pipeline_extra
     sec_per_round = _measure_rounds(
         FedSim(trainer_bf16, train, test, cfg), n_meas=3, block=10
     )
@@ -327,8 +404,15 @@ def bench_resnet(reduced: bool = False):
             sim.evaluate(variables)
         trials.append((n + n_eval) * 3 / (time.perf_counter() - t0))
     eval_eps = sorted(trials)[len(trials) // 2]
+    # pipelined-driver A-B (bf16, single-round dispatch — the path where
+    # host staging sits between device programs)
+    pipe_on, pipe_off = bench_pipeline_ab(trainer_bf16, train, test, cfg, 10)
+    pipeline_extra = {
+        "pipeline_on_rounds_per_sec": round(pipe_on, 3),
+        "pipeline_off_rounds_per_sec": round(pipe_off, 3),
+    }
     return (1.0 / sec_per_round, 1.0 / sec_per_round_single,
-            1.0 / sec_per_round_f32, eval_eps, max(trials))
+            1.0 / sec_per_round_f32, eval_eps, max(trials), pipeline_extra)
 
 
 def bench_compress_probe():
@@ -568,9 +652,21 @@ def _main(stage: list):
     baseline = cache[key]
 
     stage[0] = "bench_resnet"
-    rounds_per_sec, rounds_per_sec_single, rounds_per_sec_f32, eval_eps, eval_eps_best = bench_resnet(
+    (rounds_per_sec, rounds_per_sec_single, rounds_per_sec_f32, eval_eps,
+     eval_eps_best, pipeline_extra) = bench_resnet(
         reduced=fallback_reason is not None
     )
+
+    stage[0] = "bench_stage_probe"
+    try:
+        stage_ms, stage_ms_loop = bench_stage_probe()
+        pipeline_extra.update({
+            "host_stage_ms": round(stage_ms, 3),
+            "host_stage_ms_loop": round(stage_ms_loop, 3),
+            "host_stage_clients": STAGE_CLIENTS,
+        })
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["host_stage_error"] = f"{type(e).__name__}: {e}"
     resnet_tflops = (
         resnet56_train_flops_per_image() * CLIENTS * STEPS * BATCH * EPOCHS
         * rounds_per_sec / 1e12
@@ -652,6 +748,7 @@ def _main(stage: list):
             "resnet_f32_rounds_per_sec": rnd(rounds_per_sec_f32, 3),
             "eval_examples_per_sec": round(eval_eps, 1),
             "eval_examples_per_sec_best": round(eval_eps_best, 1),
+            **pipeline_extra,
             **compress_extra,
         },
     }))

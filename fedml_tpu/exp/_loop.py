@@ -7,6 +7,19 @@ crash mid-run still produce a truthful partial report. ``round_sleep``
 inserts an idle gap between dispatches — needed for recipes whose single
 round runs tens of seconds (the tunnel wedged twice on sustained
 back-to-back 45 s executes), pointless for sub-second rounds.
+
+When the sim exposes a nonzero ``pipeline_depth`` (FedSim's default), the
+loop is pipelined (fedml_tpu.sim.prefetch): staging for upcoming rounds
+runs on a background thread and round metrics are fetched a round behind,
+flushed at eval boundaries — per-round dispatch is kept, but the host no
+longer serializes stage -> dispatch -> fetch. Bit-identical records, up to
+``pipeline_depth`` rounds later in the file — which bounds the durability
+tradeoff: a Python exception still salvages every completed round, but a
+hard kill (SIGKILL/OOM/segfault) can lose the at-most-``pipeline_depth``
+trailing records still in the drain. Recipes that prioritize write-through
+durability over overlap set ``pipeline_depth=0`` in their SimConfig. Sims
+without the staged-round API (no ``pipeline_depth`` attribute) run the
+serial path unchanged.
 """
 
 from __future__ import annotations
@@ -39,43 +52,97 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
     server_state = sim.aggregator.init_state(variables)
     root = rnglib.root_key(cfg.seed)
     freq = max(cfg.frequency_of_the_test, 1)
+    depth = getattr(sim, "pipeline_depth", 0)
+    prefetch = drain = None
+    if depth and cfg.comm_round > 0:
+        from fedml_tpu.sim.prefetch import MetricsDrain, Prefetcher
+
+        prefetch = Prefetcher(
+            range(cfg.comm_round), lambda r: sim.stage_round(r, root), depth
+        )
+        drain = MetricsDrain(depth)
     t0 = time.time()
-    with open(metrics_out, "w") as f:
-        for r in range(cfg.comm_round):
-            try:
-                variables, server_state, m = sim.run_round(
-                    r, variables, server_state, root
-                )
-                rec = {"round": r, **{k: float(v) for k, v in m.items()}}
-                evaled = (r + 1) % freq == 0 or r == cfg.comm_round - 1
-                if evaled:
-                    rec.update(sim.eval_record(variables))
-            except Exception:
-                logging.exception(
-                    "round %d failed — reporting the %d completed rounds",
-                    r, len(records),
-                )
-                break
-            records.append(rec)
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            if evaled and stop_when is not None and stop_when(records):
-                logging.info(
-                    "stop_when fired at round %d — stopping early", r
-                )
-                break
-            if os.path.exists(metrics_out + ".stop"):
-                # graceful external stop: `touch <metrics_out>.stop` ends the
-                # run after the current round WITH the final report written —
-                # a SIGTERM would lose it (partial curves stay reportable).
-                # Consumed on use: a leftover sentinel must not kill the
-                # next run at round 0.
-                os.unlink(metrics_out + ".stop")
-                logging.info(
-                    "stop file %s.stop found at round %d — stopping",
-                    metrics_out, r,
-                )
-                break
-            if round_sleep:
-                time.sleep(round_sleep)
+    try:
+        with open(metrics_out, "w") as f:
+
+            def write(rr, metrics, eval_rec=None):
+                rec = {"round": rr,
+                       **{k: float(v) for k, v in metrics.items()}}
+                if eval_rec:
+                    rec.update(eval_rec)
+                records.append(rec)
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+
+            for r in range(cfg.comm_round):
+                try:
+                    if prefetch is not None:
+                        variables, server_state, m = sim.run_staged_round(
+                            prefetch.get(r), variables, server_state
+                        )
+                    else:
+                        variables, server_state, m = sim.run_round(
+                            r, variables, server_state, root
+                        )
+                    evaled = (r + 1) % freq == 0 or r == cfg.comm_round - 1
+                    if drain is not None:
+                        # non-blocking: queue this round's metrics on device,
+                        # fetch whatever fell off the back; evals force a
+                        # full flush (the host syncs there anyway)
+                        ready = drain.push(r, m)
+                        if evaled:
+                            ready = ready + drain.flush()
+                    else:
+                        ready = [(r, m)]
+                    # completed rounds go on the record BEFORE eval runs: an
+                    # eval failure must not lose rounds that trained fine
+                    # (only the current round's record rides on its eval,
+                    # exactly as in the serial driver)
+                    current = None
+                    for rr, mm in ready:
+                        if evaled and rr == r:
+                            current = mm
+                        else:
+                            write(rr, mm)
+                    if evaled:
+                        write(r, current, sim.eval_record(variables))
+                except Exception:
+                    logging.exception(
+                        "round %d failed — reporting the %d completed rounds",
+                        r, len(records),
+                    )
+                    break
+                if evaled and stop_when is not None and stop_when(records):
+                    logging.info(
+                        "stop_when fired at round %d — stopping early", r
+                    )
+                    break
+                if os.path.exists(metrics_out + ".stop"):
+                    # graceful external stop: `touch <metrics_out>.stop` ends
+                    # the run after the current round WITH the final report
+                    # written — a SIGTERM would lose it (partial curves stay
+                    # reportable). Consumed on use: a leftover sentinel must
+                    # not kill the next run at round 0.
+                    os.unlink(metrics_out + ".stop")
+                    logging.info(
+                        "stop file %s.stop found at round %d — stopping",
+                        metrics_out, r,
+                    )
+                    break
+                if round_sleep:
+                    time.sleep(round_sleep)
+            # salvage rounds that completed but were still queued in the
+            # drain when an exception (or stop) broke the loop — they ran
+            # fine; the partial report should include them
+            if drain is not None:
+                try:
+                    for rr, mm in drain.flush():
+                        write(rr, mm)
+                except Exception:
+                    logging.exception(
+                        "draining pending round metrics failed"
+                    )
+    finally:
+        if prefetch is not None:
+            prefetch.close()
     return records, (time.time() - t0) or 1.0

@@ -124,6 +124,12 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--stage_on_device", type=int, default=-1,
                         help="-1 auto, 0 host staging, 1 device-resident "
                              "dataset + in-program gather")
+    parser.add_argument("--pipeline_depth", type=int, default=-1,
+                        help="pipelined round driver: -1 auto (double-"
+                             "buffered staging prefetch + deferred metrics "
+                             "drain), 0 serial driver, N>0 stage up to N "
+                             "dispatches ahead (docs/PERFORMANCE.md); "
+                             "bit-identical results either way")
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="capture a jax.profiler trace of the round loop")
     # observability
@@ -386,6 +392,8 @@ def run(args) -> list[dict]:
         eval_on_clients=bool(args.eval_on_clients),
         stage_on_device=(None if args.stage_on_device < 0
                          else bool(args.stage_on_device)),
+        pipeline_depth=(None if getattr(args, "pipeline_depth", -1) < 0
+                        else args.pipeline_depth),
         compressor=getattr(args, "compressor", "none"),
         topk_frac=getattr(args, "topk_frac", 0.01),
         quantize_bits=getattr(args, "quantize_bits", 8),

@@ -46,9 +46,143 @@ class FederatedArrays:
     def max_client_size(self) -> int:
         return int(self.client_sizes().max())
 
+    def index_csr(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, dict | None]:
+        """The vectorized form of ``partition``, in a ragged CSR layout:
+        ``flat`` (every client's sample rows concatenated, int32),
+        ``offsets`` (int64, client row i owns flat[offsets[i]:offsets[i]+
+        sizes[i]]), ``sizes`` (int64), and a client-id -> row lookup (None
+        when ids are the usual contiguous 0..N-1, so rows are indexed
+        directly; cross-silo keys its single-client shards by global silo
+        index, hence the general case). CSR rather than a dense padded
+        matrix keeps the cache O(total samples) on skewed populations —
+        one giant client must not multiply the whole population's footprint.
+        Built once (the only remaining O(num_clients) Python loop) and
+        cached — every round's staging reads it, so the partition is
+        treated as immutable after the first call."""
+        cached = self.__dict__.get("_index_csr")
+        if cached is None:
+            keys = sorted(self.partition)
+            sizes = np.asarray(
+                [len(self.partition[k]) for k in keys], np.int64
+            )
+            flat = (
+                np.concatenate(
+                    [np.asarray(self.partition[k], np.int32).ravel()
+                     for k in keys]
+                )
+                if keys else np.zeros(0, np.int32)
+            )
+            offsets = np.zeros(len(keys), np.int64)
+            if len(keys):
+                np.cumsum(sizes[:-1], out=offsets[1:])
+            lookup = (
+                None if keys == list(range(len(keys)))
+                else {k: row for row, k in enumerate(keys)}
+            )
+            cached = (flat, offsets, sizes, lookup)
+            self.__dict__["_index_csr"] = cached
+        return cached
+
 
 def steps_per_epoch(max_client_size: int, batch_size: int) -> int:
     return max(1, -(-max_client_size // batch_size))
+
+
+def cohort_index_map(
+    data: FederatedArrays,
+    client_ids: np.ndarray,
+    batch_size: int,
+    steps: int | None = None,
+    rng: np.random.RandomState | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Vectorized cohort staging: the round's [C, S, B] int32 sample-index
+    map (-1 = empty slot) and [C] float32 true sample counts, built with a
+    fixed number of numpy ops per round instead of a per-client Python loop.
+
+    This is the ONE definition of cohort selection: host batch stacks
+    (:func:`stack_cohort` gathers rows through it), the on-device gather
+    path, block dispatch, and per-client eval all stage via this map, so
+    their shuffle/truncation/zero-fill semantics cannot drift.
+
+    ``rng`` shuffles each client's sample order by drawing one
+    [C, max cohort size] uniform block and argsorting each row (padding is
+    sunk to the tail) — a uniform per-client permutation in one vectorized
+    draw, sized by THIS cohort's largest member, not the population's. Clients with more samples than ``steps * batch_size``
+    slots keep the first ``slots`` entries of their (shuffled) order — a
+    without-replacement subsample over ALL their samples, exactly the old
+    permute-then-truncate semantics; weights still report the true client
+    size.
+    """
+    flat, offsets, sizes, lookup = data.index_csr()
+    if lookup is None:
+        rows = np.asarray(client_ids, dtype=np.intp)
+    else:
+        rows = np.asarray([lookup[int(c)] for c in client_ids], dtype=np.intp)
+    sz = sizes[rows]
+    if steps is None:
+        steps = steps_per_epoch(int(sz.max()), batch_size)
+    slots = steps * batch_size
+    # unshuffled, truncation == keeping each row's first `slots` entries, so
+    # the gather can stop there; a shuffle must permute the FULL row first
+    width = int(sz.max()) if len(sz) else 0
+    if rng is None:
+        width = min(width, slots)
+    width = max(width, 1)
+    col = np.arange(width)
+    valid = col[None, :] < sz[:, None]
+    all_full = bool(valid.all())
+    gather = offsets[rows][:, None] + col[None, :]
+    guard = max(len(flat) - 1, 0)
+    sel = (
+        flat[np.minimum(gather, guard)]
+        if len(flat) else np.full(gather.shape, -1, np.int32)
+    )
+    if not all_full:
+        sel[~valid] = -1
+    if rng is not None:
+        # argsort of iid uniforms = a uniform permutation per row (tie
+        # probability ~ C*L^2 * 2^-53, ignorable); +inf sinks the padding
+        # to the row tail (every pad slot is the same -1, so pad order is
+        # irrelevant and the default sort suffices)
+        u = rng.random_sample(sel.shape)
+        if not all_full:
+            u[~valid] = np.inf
+        sel = np.take_along_axis(sel, np.argsort(u, axis=1), axis=1)
+    if width < slots:
+        sel = np.pad(sel, ((0, 0), (0, slots - width)), constant_values=-1)
+    elif width > slots:
+        sel = sel[:, :slots]
+    return (
+        np.ascontiguousarray(sel).reshape(len(rows), steps, batch_size),
+        sz.astype(np.float32),
+    )
+
+
+def _cohort_index_map_loop(
+    data: FederatedArrays,
+    client_ids: np.ndarray,
+    batch_size: int,
+    steps: int | None = None,
+    rng: np.random.RandomState | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Pre-vectorization reference (per-client Python loop), kept as the
+    oracle for :func:`cohort_index_map` and as the bench's staging-overhead
+    baseline (``host_stage_ms_loop``). Shuffle draws differ by construction
+    (per-client ``permutation`` calls vs one block draw), so bit-exact
+    comparisons use ``rng=None``."""
+    sizes = np.asarray([len(data.partition[int(c)]) for c in client_ids])
+    if steps is None:
+        steps = steps_per_epoch(int(sizes.max()), batch_size)
+    slots = steps * batch_size
+    C = len(client_ids)
+    idx = np.full((C, slots), -1, np.int32)
+    for ci, cid in enumerate(client_ids):
+        sel = data.partition[int(cid)]
+        if rng is not None:
+            sel = rng.permutation(sel)
+        n = min(len(sel), slots)
+        idx[ci, :n] = sel[:n]
+    return idx.reshape(C, steps, batch_size), sizes.astype(np.float32)
 
 
 def stack_cohort(
@@ -65,41 +199,28 @@ def stack_cohort(
     aggregation weights, FedAVGAggregator.py:59-88). ``steps`` pins S so every
     round has identical shapes; default = fit the largest cohort member.
     ``rng`` shuffles each client's sample order (torch DataLoader shuffle
-    semantics).
+    semantics). Selection runs through :func:`cohort_index_map`, so the host
+    stack is the gathered image of the exact index map the on-device path
+    ships — one vectorized gather instead of a per-client copy loop.
     """
-    C = len(client_ids)
-    sizes = np.asarray([len(data.partition[int(c)]) for c in client_ids])
-    if steps is None:
-        steps = steps_per_epoch(int(sizes.max()), batch_size)
-    slots = steps * batch_size
-
-    stack: dict[str, np.ndarray] = {}
+    idx, sizes = cohort_index_map(data, client_ids, batch_size, steps=steps, rng=rng)
+    C, S, B = idx.shape
+    flat = idx.reshape(C, S * B)
+    valid = flat >= 0
+    safe = np.where(valid, flat, 0).reshape(-1)
+    batch_stack: dict[str, np.ndarray] = {}
     for name, arr in data.arrays.items():
-        out = np.zeros((C, slots) + arr.shape[1:], dtype=arr.dtype)
-        stack[name] = out
-    mask = np.zeros((C, slots), dtype=np.float32)
-
-    for ci, cid in enumerate(client_ids):
-        idxs = data.partition[int(cid)]
-        if rng is not None:
-            idxs = rng.permutation(idxs)
-        n = min(len(idxs), slots)
-        for name, arr in data.arrays.items():
-            stack[name][ci, :n] = arr[idxs[:n]]
-        mask[ci, :n] = 1.0
-
-    batch_stack = {
-        name: arr.reshape((C, steps, batch_size) + arr.shape[2:])
-        for name, arr in stack.items()
-    }
-    example_mask = mask.reshape(C, steps, batch_size)
+        gathered = arr[safe].reshape((C, S * B) + arr.shape[1:])
+        gathered[~valid] = 0  # empty slots are zero-filled, exactly as before
+        batch_stack[name] = gathered.reshape((C, S, B) + arr.shape[1:])
+    example_mask = valid.astype(np.float32).reshape(C, S, B)
     if "mask" in batch_stack:
         # sequence tasks: combine per-token mask with example validity
         tok = batch_stack["mask"].astype(np.float32)
         batch_stack["mask"] = tok * example_mask.reshape(example_mask.shape + (1,) * (tok.ndim - 3))
     else:
         batch_stack["mask"] = example_mask
-    return batch_stack, sizes.astype(np.float32)
+    return batch_stack, sizes
 
 
 def batch_array(arrays: dict[str, np.ndarray], batch_size: int) -> dict[str, np.ndarray]:

@@ -96,6 +96,17 @@ class SimConfig:
     # client identity only at full participation (rng.sample_clients returns
     # arange there) — enforced at engine construction.
     error_feedback: bool = True
+    # Pipelined round driver (sim/prefetch.py, docs/PERFORMANCE.md): a
+    # background thread builds and device_puts the NEXT dispatch's staging
+    # (index maps / batch stacks) while the current one executes, and round
+    # metrics stay on device in a drain queue fetched a round behind —
+    # the driver only synchronizes at eval boundaries and at the end.
+    # Depth N keeps up to N dispatches staged ahead; 0 = serial (stage,
+    # dispatch, fetch every round); None = auto (depth 1, double buffering,
+    # on for host-staged and on-device paths alike). Staging is a pure
+    # function of (seed, round), so the pipelined driver is bit-identical
+    # to the serial one (tools/pipeline_smoke.py guards this).
+    pipeline_depth: int | None = None
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
@@ -307,6 +318,13 @@ class FedSim:
                 )
 
 
+    @property
+    def pipeline_depth(self) -> int:
+        """Effective prefetch/drain depth (0 = serial driver); see
+        SimConfig.pipeline_depth."""
+        d = self.config.pipeline_depth
+        return 1 if d is None else max(0, int(d))
+
     def _put(self, value, sharding):
         """device_put that also works when ``self.mesh`` spans processes
         (multi-controller): each process supplies only the shards it owns
@@ -479,13 +497,12 @@ class FedSim:
             )
         return self._block_fns[n_rounds]
 
-    def run_block(self, start_round: int, n_rounds: int, global_variables,
-                  server_state, root_rng):
-        """Run ``n_rounds`` consecutive rounds in ONE device dispatch
-        (on-device-dataset path only). Returns (variables, server_state,
-        stacked metrics dict with a leading [n_rounds] axis)."""
-        if not self._on_device:
-            raise ValueError("run_block requires the on-device dataset path")
+    def _stage_block(self, start_round: int, n_rounds: int, root_rng):
+        """Host staging for one R-round block: stacked [R, C_pad, ...]
+        index/weight/step arrays (each round's slice built by the vectorized
+        cohort builder) shipped with block sharding, plus per-round rng
+        keys. Pure in (config, rounds, root_rng), so the prefetch thread
+        can build the next block while the current one executes."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         per_round = [
@@ -500,6 +517,21 @@ class FedSim:
             rnglib.round_key(root_rng, r)
             for r in range(start_round, start_round + n_rounds)
         ])
+        return idxs, weights, num_steps, rngs
+
+    def run_block(self, start_round: int, n_rounds: int, global_variables,
+                  server_state, root_rng, staged=None):
+        """Run ``n_rounds`` consecutive rounds in ONE device dispatch
+        (on-device-dataset path only). Returns (variables, server_state,
+        stacked metrics dict with a leading [n_rounds] axis). ``staged``
+        passes a pre-built _stage_block payload (the pipelined driver's
+        prefetch thread); default stages inline."""
+        if not self._on_device:
+            raise ValueError("run_block requires the on-device dataset path")
+        idxs, weights, num_steps, rngs = (
+            staged if staged is not None
+            else self._stage_block(start_round, n_rounds, root_rng)
+        )
         return self._get_block_fn(n_rounds)(
             global_variables, server_state, self._dataset, idxs, weights,
             num_steps, rngs,
@@ -611,32 +643,30 @@ class FedSim:
 
     def _host_cohort_indices(self, cohort, round_idx: int):
         """Host-side index staging: [C_pad, S, B] int32 index map (-1 = empty
-        slot) + weights + per-client step budgets, padded to the mesh."""
+        slot) + weights + per-client step budgets, padded to the mesh.
+        Vectorized (cohortlib.cohort_index_map): a fixed number of numpy ops
+        per round regardless of cohort size — the builder run_round,
+        run_block, and evaluate_per_client all share."""
         cfg = self.config
-        slots = self._steps * cfg.batch_size
         shuffle = (
             np.random.RandomState(cfg.seed * 1_000_003 + round_idx)
             if cfg.shuffle_each_round
             else None
         )
-        C = len(cohort)
-        idx = np.full((C, slots), -1, np.int32)
-        weights = np.zeros(C, np.float32)
-        for ci, cid in enumerate(cohort):
-            sel = self.train_data.partition[int(cid)]
-            if shuffle is not None:
-                sel = shuffle.permutation(sel)
-            n = min(len(sel), slots)
-            idx[ci, :n] = sel[:n]
-            weights[ci] = len(sel)
+        idx, weights = cohortlib.cohort_index_map(
+            self.train_data, cohort, cfg.batch_size, steps=self._steps,
+            rng=shuffle,
+        )
         num_steps = self._round_budgets(cohort, round_idx)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
-        pad = (-C) % n_dev
+        pad = (-len(cohort)) % n_dev
         if pad:
-            idx = np.concatenate([idx, np.full((pad, slots), -1, np.int32)])
+            idx = np.concatenate(
+                [idx, np.full((pad,) + idx.shape[1:], -1, np.int32)]
+            )
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
-        return idx.reshape(-1, self._steps, cfg.batch_size), weights, num_steps
+        return idx, weights, num_steps
 
     def stage_cohort_indices(self, cohort, round_idx: int):
         """Device staging for the on-device-dataset path: instead of the full
@@ -665,22 +695,46 @@ class FedSim:
         """One round over an explicit cohort: stage (on-device index map or
         host batches) and dispatch. Shared by run_round and compositions
         that pick their own cohorts (HierarchicalFedAvg's groups)."""
+        return self.run_staged_round(
+            self.stage_cohort_round(cohort, round_idx, rkey),
+            global_variables, server_state,
+        )
+
+    def stage_round(self, round_idx: int, root_rng):
+        """All host work for one round — cohort sampling, vectorized index/
+        batch staging, device_put, rng-key derivation. Pure in (config,
+        round_idx, root_rng): prefetching it ahead of the dispatch loop
+        (sim/prefetch.py) cannot change cohorts, keys, or metrics."""
+        rkey = rnglib.round_key(root_rng, round_idx)
+        cohort = self._sample_round_cohort(round_idx)
+        return self.stage_cohort_round(cohort, round_idx, rkey)
+
+    def stage_cohort_round(self, cohort, round_idx: int, rkey):
+        """Staged payload for one round over an explicit cohort (the
+        on-device index map or the host batch stack, + weights, budgets,
+        and the round's rng key)."""
         if self._on_device:
-            idx, weights, num_steps = self.stage_cohort_indices(cohort, round_idx)
+            staged = self.stage_cohort_indices(cohort, round_idx)
+        else:
+            staged = self.stage_cohort(cohort, round_idx)
+        return staged + (rkey,)
+
+    def run_staged_round(self, staged, global_variables, server_state):
+        """Dispatch one round from a stage_round payload."""
+        data, weights, num_steps, rkey = staged
+        if self._on_device:
             return self._gather_round_fn(
-                global_variables, server_state, self._dataset, idx, weights,
+                global_variables, server_state, self._dataset, data, weights,
                 num_steps, rkey,
             )
-        batches, weights, num_steps = self.stage_cohort(cohort, round_idx)
         return self._round_fn(
-            global_variables, server_state, batches, weights, num_steps, rkey
+            global_variables, server_state, data, weights, num_steps, rkey
         )
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
-        rkey = rnglib.round_key(root_rng, round_idx)
-        cohort = self._sample_round_cohort(round_idx)
-        return self.run_cohort_round(
-            cohort, round_idx, global_variables, server_state, rkey
+        return self.run_staged_round(
+            self.stage_round(round_idx, root_rng), global_variables,
+            server_state,
         )
 
     def evaluate_per_client(
@@ -722,15 +776,15 @@ class FedSim:
             pad = csz - len(sel)
             padded = np.concatenate([sel, np.repeat(sel[-1:], pad)]) if pad else sel
             if use_resident:
-                slots = steps * bs
-                idx = np.full((csz, slots), -1, np.int32)
-                for ci, cid in enumerate(sel):  # pad rows stay -1 (masked)
-                    rows = data.partition[int(cid)]
-                    n = min(len(rows), slots)
-                    idx[ci, :n] = rows[:n]
+                # same vectorized index builder as the round path; pad rows
+                # stay all -1 (fully masked)
+                idx, _ = cohortlib.cohort_index_map(data, sel, bs, steps=steps)
+                if pad:
+                    idx = np.concatenate(
+                        [idx, np.full((pad,) + idx.shape[1:], -1, np.int32)]
+                    )
                 m = self._client_eval_gather_fn(
-                    variables, self._dataset,
-                    self._put(idx.reshape(csz, steps, bs), self._rep),
+                    variables, self._dataset, self._put(idx, self._rep),
                 )
             else:
                 stack = cohortlib.stack_client_eval(data, padded, bs, steps=steps)
@@ -792,76 +846,152 @@ class FedSim:
             out["Test/Loss"] = float(test_m["Loss"])
         return out
 
+    def _dispatch_plan(self, start_round: int) -> list[tuple[int, int]]:
+        """The run's dispatch segments ``[(first_round, n_rounds), ...]``:
+        eval-aligned blocks when block dispatch is on (one device dispatch
+        per block amortizes host->device latency; alignment keeps every eval
+        at a block end so accuracy is attributed to the right round),
+        single rounds otherwise. Under profiling the first segment runs
+        alone so the trace skips compilation. Deterministic up front, so
+        staging can be prefetched ahead of the dispatch loop."""
+        cfg = self.config
+        freq = max(cfg.frequency_of_the_test, 1)
+        plan = []
+        r = start_round
+        while r < cfg.comm_round:
+            next_eval = ((r // freq) + 1) * freq
+            n = (min(cfg.comm_round, next_eval) - r
+                 if self._block_dispatch else 1)
+            if cfg.profile_dir and r == start_round:
+                n = 1
+            plan.append((r, n))
+            r += n
+        return plan
+
+    def _stage_segment(self, segment: tuple[int, int], root_rng):
+        r, n = segment
+        if n == 1:
+            return self.stage_round(r, root_rng)
+        return self._stage_block(r, n, root_rng)
+
     def run(self, callback=None, variables=None, server_state=None,
             start_round: int = 0) -> tuple[Pytree, list[dict]]:
         """Run the configured rounds. ``variables``/``server_state``/
         ``start_round`` resume from a checkpoint (obs/checkpoint.py);
-        defaults start fresh."""
+        defaults start fresh.
+
+        With ``pipeline_depth`` > 0 (the default) the driver is pipelined
+        (sim/prefetch.py): a background thread stages upcoming dispatches
+        while the device executes the current one, and round metrics drain
+        a dispatch behind — the host synchronizes with the device only at
+        eval boundaries and at the end. Bit-identical to the serial driver
+        (``pipeline_depth=0``); records reach ``callback`` and the history
+        in round order, delivered at each synchronization point.
+        ``round_time`` (on each segment's last round) is the synchronization
+        window's per-round wall-time average, so summing it over
+        single-round dispatches recovers the run's wall time just as in the
+        serial driver."""
+        from fedml_tpu.sim.prefetch import MetricsDrain, Prefetcher
+
         cfg = self.config
         if variables is None:
             variables = self.init_round_variables()
         if server_state is None:
             server_state = self.aggregator.init_state(variables)
         root = rnglib.root_key(cfg.seed)
-        history = []
+        history: list[dict] = []
         profiling = False
-        # Dispatch rounds in blocks aligned to eval boundaries (one device
-        # dispatch per block amortizes host->device latency; alignment keeps
-        # every eval at a block end so accuracy is attributed to the right
-        # round); single-round dispatch when blocks are off (host-staged
-        # dataset, or XLA:CPU — see SimConfig.block_dispatch).
         freq = max(cfg.frequency_of_the_test, 1)
+        plan = self._dispatch_plan(start_round)
+        depth = self.pipeline_depth
+        prefetch = (
+            Prefetcher(plan, lambda seg: self._stage_segment(seg, root), depth)
+            if depth and plan else None
+        )
+        drain = MetricsDrain(depth)
+
+        def is_eval_round(rr: int) -> bool:
+            return (rr + 1) % freq == 0 or rr == cfg.comm_round - 1
+
+        def emit(segment, stacked_np, per_round_time=None, eval_rec=None):
+            r0, n = segment
+            for j in range(n):
+                rr = r0 + j
+                rec = {"round": rr}
+                if j == n - 1 and per_round_time is not None:
+                    rec["round_time"] = per_round_time
+                rec.update({k: float(v[j]) for k, v in stacked_np.items()})
+                if j == n - 1 and eval_rec:
+                    rec.update(eval_rec)
+                history.append(rec)
+                if callback:
+                    callback(rec)
+                logging.info(
+                    "round %d: %s", rr,
+                    {k: v for k, v in rec.items() if k != "round"},
+                )
+
+        t_mark = time.perf_counter()
+        rounds_in_window = 0
+        # metrics fetched mid-window (they fell off the drain's back) are
+        # held here and emitted at the window's sync point, where the
+        # per-round wall time they should carry is known
+        pending: list[tuple] = []
         try:
-            r = start_round
-            while r < cfg.comm_round:
+            for segment in plan:
+                r0, n = segment
                 # start the trace after the first round so compilation
                 # doesn't drown the steady-state rounds in the profile (a
                 # 1-round run traces its only round, compilation included)
                 if cfg.profile_dir and not profiling and (
-                    r > start_round or cfg.comm_round - start_round == 1
+                    r0 > start_round or cfg.comm_round - start_round == 1
                 ):
                     jax.profiler.start_trace(cfg.profile_dir)
                     profiling = True
-                next_eval = ((r // freq) + 1) * freq
-                n = (min(cfg.comm_round, next_eval) - r
-                     if self._block_dispatch else 1)
-                # the first round runs alone so the profile skips compilation
-                if cfg.profile_dir and r == start_round:
-                    n = 1
-                t0 = time.perf_counter()
+                staged = prefetch.get(segment) if prefetch else None
                 if n == 1:
-                    variables, server_state, metrics = self.run_round(
-                        r, variables, server_state, root
+                    if staged is None:
+                        staged = self.stage_round(r0, root)
+                    variables, server_state, metrics = self.run_staged_round(
+                        staged, variables, server_state
                     )
-                    stacked = {k: jnp.asarray(v)[None] for k, v in metrics.items()}
+                    stacked = {
+                        k: jnp.asarray(v)[None] for k, v in metrics.items()
+                    }
                 else:
                     variables, server_state, stacked = self.run_block(
-                        r, n, variables, server_state, root
+                        r0, n, variables, server_state, root, staged=staged
                     )
-                stacked = {k: np.asarray(v) for k, v in stacked.items()}
-                block_time = None
-                for j in range(n):
-                    rr = r + j
-                    if block_time is None and j == n - 1:
+                rounds_in_window += n
+                last = r0 + n - 1
+                if is_eval_round(last) or depth == 0:
+                    # synchronization point: fetch everything queued
+                    # (including this segment's metrics), then eval
+                    ready = pending + drain.push(segment, stacked) + drain.flush()
+                    pending = []
+                    if depth == 0:
                         jax.block_until_ready(variables)
-                        block_time = time.perf_counter() - t0
-                    rec = {
-                        "round": rr,
-                        "round_time": (block_time / n) if j == n - 1 else None,
-                    }
-                    rec.update({k: float(v[j]) for k, v in stacked.items()})
-                    if (rr + 1) % freq == 0 or rr == cfg.comm_round - 1:
-                        rec.update(self.eval_record(variables))
-                    rec = {k: v for k, v in rec.items() if v is not None}
-                    history.append(rec)
-                    if callback:
-                        callback(rec)
-                    logging.info(
-                        "round %d: %s", rr,
-                        {k: v for k, v in rec.items() if k != "round"},
+                    per_round = (
+                        (time.perf_counter() - t_mark)
+                        / max(rounds_in_window, 1)
                     )
-                r += n
+                    eval_rec = (
+                        self.eval_record(variables)
+                        if is_eval_round(last) else None
+                    )
+                    for pseg, pstacked in ready:
+                        emit(pseg, pstacked, per_round_time=per_round,
+                             eval_rec=eval_rec if pseg == segment else None)
+                    t_mark = time.perf_counter()
+                    rounds_in_window = 0
+                else:
+                    # non-blocking: only metrics that fell off the drain's
+                    # back (already-finished dispatches) are fetched; they
+                    # are emitted at the window's sync point with its timing
+                    pending.extend(drain.push(segment, stacked))
         finally:
+            if prefetch:
+                prefetch.close()
             if profiling:
                 jax.profiler.stop_trace()
         return variables, history

@@ -9,7 +9,11 @@ from pathlib import Path
 import pytest
 
 DOCS_DIR = Path(__file__).parent.parent / "docs"
-DOCS = [DOCS_DIR / "MIGRATION.md", DOCS_DIR / "COMPRESSION.md"]
+DOCS = [
+    DOCS_DIR / "MIGRATION.md",
+    DOCS_DIR / "COMPRESSION.md",
+    DOCS_DIR / "PERFORMANCE.md",
+]
 
 
 @pytest.mark.parametrize("doc", DOCS, ids=lambda p: p.name)
@@ -49,10 +53,10 @@ def test_doc_cli_entries_exist(doc):
         assert hasattr(m, "main"), mod
 
 
-def test_compression_doc_tools_exist():
-    """The smoke script the doc points at is runnable (has a main)."""
-    text = (DOCS_DIR / "COMPRESSION.md").read_text()
-    for rel in set(re.findall(r"tools/\w+\.py", text)):
+@pytest.mark.parametrize("doc", DOCS, ids=lambda p: p.name)
+def test_doc_tools_exist(doc):
+    """Every tools/*.py script a doc points at is runnable (has a main)."""
+    for rel in set(re.findall(r"tools/\w+\.py", doc.read_text())):
         path = DOCS_DIR.parent / rel
         assert path.exists(), rel
         assert "def main" in path.read_text(), rel

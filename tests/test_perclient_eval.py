@@ -71,6 +71,19 @@ def test_profile_dir_produces_trace(tmp_path):
     assert any(p.is_file() for p in produced), "no profile artifact written"
 
 
+def test_per_client_eval_resident_chunked_equals_unchunked():
+    """Regression for the resident-path index build (now shared with the
+    round path's vectorized builder): chunked eval must equal unchunked,
+    including the padded final chunk."""
+    sim, _ = _sim(stage_on_device=True)
+    assert sim._on_device
+    variables = sim.init_round_variables()
+    full = sim.evaluate_per_client(variables, chunk=64)
+    chunked = sim.evaluate_per_client(variables, chunk=4)  # 2 chunks + pad
+    for k in full:
+        np.testing.assert_allclose(full[k], chunked[k], rtol=1e-6)
+
+
 def test_per_client_eval_resident_matches_host_path():
     import dataclasses
 
