@@ -304,6 +304,78 @@ def bench_pipeline_ab(trainer, train, test, cfg, n_rounds: int):
     return rps(None), rps(0)
 
 
+PACK_CLIENTS = 256  # the packed-lane probe's Zipf cohort size
+PACK_LANES = 16
+
+
+def bench_pack_ab(n_rounds: int = 3):
+    """Packed-vs-padded A/B (docs/PERFORMANCE.md "Packed-lane cohort
+    execution") on a Zipf-partitioned 256-client full-participation cohort:
+    the head client holds 64 steps of data, the median client one — the
+    paper's non-IID shape, where the padded layout scans 256 x 64 steps and
+    masks most of them. Reports rounds/sec through FedSim.run() for both
+    modes plus each mode's padding-step fraction (fraction of scanned steps
+    that are masked no-ops). Both arms run once to warm, once measured.
+    Returns a dict of probe metrics."""
+    import dataclasses
+
+    import numpy as np
+
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.cohort import FederatedArrays
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    C, B, F, K = PACK_CLIENTS, 16, 64, 16
+    sizes = np.maximum((1024 / np.arange(1, C + 1) ** 1.1), 1).astype(int)
+    rng = np.random.RandomState(0)
+    n = int(sizes.sum())
+    x = rng.rand(n, F).astype(np.float32)
+    y = rng.randint(0, K, n).astype(np.int32)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    part = {i: np.arange(bounds[i], bounds[i + 1]) for i in range(C)}
+    train = FederatedArrays({"x": x, "y": y}, part)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=K),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=n_rounds, epochs=1, frequency_of_the_test=10_000,
+        shuffle_each_round=False, seed=0, block_dispatch=False,
+    )
+
+    def rps(pack_lanes):
+        sim = FedSim(trainer, train, None,
+                     dataclasses.replace(cfg, pack_lanes=pack_lanes))
+        sim.run()  # compile + warm
+        t0 = time.perf_counter()
+        _, hist = sim.run()
+        return len(hist) / (time.perf_counter() - t0), sim
+
+    packed_rps, packed_sim = rps(PACK_LANES)
+    padded_rps, _ = rps(0)
+    # padding-step fractions from the round-0 plan (full participation, no
+    # shuffle: every round packs identically) — host-side planning only
+    stats = packed_sim.pack_round_stats(0)
+    return {
+        "pack_zipf_clients": C,
+        "pack_lanes": PACK_LANES,
+        "pack_rounds_per_sec": round(packed_rps, 3),
+        "padded_rounds_per_sec": round(padded_rps, 3),
+        "pack_speedup": round(packed_rps / padded_rps, 2),
+        "pack_n_passes": stats["n_passes"],
+        "padding_step_frac_padded": round(
+            1.0 - stats["total_steps"] / stats["padded_steps"], 4
+        ),
+        "padding_step_frac_packed": round(
+            1.0 - stats["total_steps"] / stats["capacity"], 4
+        ),
+    }
+
+
 def bench_resnet(reduced: bool = False):
     """(rounds/sec, eval examples/sec, pipeline extras) for the primary
     ResNet-56 config.
@@ -656,6 +728,12 @@ def _main(stage: list):
      eval_eps_best, pipeline_extra) = bench_resnet(
         reduced=fallback_reason is not None
     )
+
+    stage[0] = "bench_pack_probe"
+    try:
+        pipeline_extra.update(bench_pack_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["pack_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_stage_probe"
     try:

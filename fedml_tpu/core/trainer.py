@@ -312,6 +312,36 @@ def make_local_train(trainer: ClientTrainer):
     return local_train
 
 
+def make_lane_step(trainer: ClientTrainer):
+    """One packed-lane step: ``lane_step(variables, opt_state, global_variables,
+    opt0, batch, rng, is_first) -> (variables, opt_state, loss, w)``.
+
+    The packed execution mode (sim/engine.py, SimConfig.pack_lanes) scans a
+    lane carrying ONE client's training state at a time; ``is_first`` marks a
+    client boundary — the carry is reset to the broadcast global variables and
+    the freshly-initialized optimizer state ``opt0`` (a pure select, no
+    arithmetic, so the reset is bit-exact) before the ordinary
+    :meth:`ClientTrainer.train_step` runs. ``w`` is the step's loss weight
+    (did this step see any data), exactly as in :func:`make_local_train`'s
+    step body. Designed to be ``vmap``-ed over the lane axis with ``is_first``
+    a per-lane scalar."""
+
+    def lane_step(variables: Pytree, opt_state, global_variables: Pytree,
+                  opt0, batch: Batch, rng: jax.Array, is_first):
+        reset = lambda fresh, carried: jax.tree.map(  # noqa: E731
+            lambda a, b: jnp.where(is_first, a, b), fresh, carried
+        )
+        variables = reset(global_variables, variables)
+        opt_state = reset(opt0, opt_state)
+        variables, opt_state, loss = trainer.train_step(
+            variables, opt_state, global_variables["params"], batch, rng
+        )
+        w = (jnp.sum(batch["mask"]) > 0).astype(jnp.float32)
+        return variables, opt_state, loss, w
+
+    return lane_step
+
+
 def make_local_update(trainer: ClientTrainer, codec=None, local_train_fn=None):
     """Compressed local-update program: ``local_update(global_variables,
     data, rng, residual=None, num_steps=None) -> (payload, new_residual,

@@ -51,6 +51,12 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
     variables = sim.init_round_variables()
     server_state = sim.aggregator.init_state(variables)
     root = rnglib.root_key(cfg.seed)
+    pack = getattr(sim, "pack_summary", lambda: {})()
+    if pack:
+        # packed-lane execution (SimConfig.pack_lanes): record the lane
+        # geometry next to the run so a report reader can tell which
+        # execution mode produced the (bit-identical) curve
+        logging.info("packed-lane execution: %s", pack)
     freq = max(cfg.frequency_of_the_test, 1)
     depth = getattr(sim, "pipeline_depth", 0)
     prefetch = drain = None

@@ -124,6 +124,18 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--stage_on_device", type=int, default=-1,
                         help="-1 auto, 0 host staging, 1 device-resident "
                              "dataset + in-program gather")
+    parser.add_argument("--pack_lanes", type=int, default=0,
+                        help="packed-lane cohort execution (docs/"
+                             "PERFORMANCE.md): bin-pack each round's "
+                             "per-client step streams into N fixed-length "
+                             "lanes per mesh shard instead of padding every "
+                             "client to the cohort max — the FLOP win on "
+                             "power-law client populations. 0 = off (padded "
+                             "path); bit-identical results either way")
+    parser.add_argument("--pack_capacity_factor", type=float, default=1.25,
+                        help="lane-length head room over the expected "
+                             "per-shard cohort load; overflow draws spill "
+                             "to an extra sequential pass")
     parser.add_argument("--pipeline_depth", type=int, default=-1,
                         help="pipelined round driver: -1 auto (double-"
                              "buffered staging prefetch + deferred metrics "
@@ -394,6 +406,8 @@ def run(args) -> list[dict]:
                          else bool(args.stage_on_device)),
         pipeline_depth=(None if getattr(args, "pipeline_depth", -1) < 0
                         else args.pipeline_depth),
+        pack_lanes=getattr(args, "pack_lanes", 0),
+        pack_capacity_factor=getattr(args, "pack_capacity_factor", 1.25),
         compressor=getattr(args, "compressor", "none"),
         topk_frac=getattr(args, "topk_frac", 0.01),
         quantize_bits=getattr(args, "quantize_bits", 8),

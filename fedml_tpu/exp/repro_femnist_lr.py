@@ -62,6 +62,8 @@ def run(args) -> dict:
         epochs=1,
         frequency_of_the_test=args.frequency_of_the_test,
         seed=args.seed,
+        pack_lanes=args.pack_lanes,
+        pack_capacity_factor=args.pack_capacity_factor,
     )
     sim = FedSim(trainer, ds.train, ds.test_arrays, cfg)
     records, wall = run_rounds(sim, cfg, args.metrics_out)
@@ -165,6 +167,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--lr", type=float, default=0.003)
     parser.add_argument("--comm_round", type=int, default=400)
     parser.add_argument("--frequency_of_the_test", type=int, default=10)
+    parser.add_argument("--pack_lanes", type=int, default=0,
+                        help="packed-lane cohort execution (docs/"
+                             "PERFORMANCE.md): N lanes per mesh shard "
+                             "bin-packed from the cohort's step streams "
+                             "instead of padding to the straggler max; "
+                             "0 = padded path (bit-identical either way)")
+    parser.add_argument("--pack_capacity_factor", type=float, default=1.25,
+                        help="lane-length head room over the expected "
+                             "per-shard cohort load (overflow spills to an "
+                             "extra sequential pass)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--metrics_out", type=str,
                         default="repro_femnist_lr_metrics.jsonl")

@@ -120,6 +120,8 @@ def run(args) -> dict:
         epochs=1,
         frequency_of_the_test=args.frequency_of_the_test,
         seed=args.seed,
+        pack_lanes=args.pack_lanes,
+        pack_capacity_factor=args.pack_capacity_factor,
         # THE row's systems point: population >> cohort. Keep the dataset
         # host-side; each round stages only its 50-client cohort.
         stage_on_device=False,
@@ -256,6 +258,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="cap loaded clients (None = full population)")
     parser.add_argument("--comm_round", type=int, default=1500)
     parser.add_argument("--frequency_of_the_test", type=int, default=50)
+    parser.add_argument("--pack_lanes", type=int, default=0,
+                        help="packed-lane cohort execution (docs/"
+                             "PERFORMANCE.md): N lanes per mesh shard "
+                             "bin-packed from the cohort's step streams "
+                             "instead of padding to the straggler max; "
+                             "0 = padded path (bit-identical either way)")
+    parser.add_argument("--pack_capacity_factor", type=float, default=1.25,
+                        help="lane-length head room over the expected "
+                             "per-shard cohort load (overflow spills to an "
+                             "extra sequential pass)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--train_eval_samples", type=int, default=50_000,
                         help="cap the pooled-train eval subset (None/0 = "

@@ -40,6 +40,8 @@ def run(args) -> dict:
             client_num_per_round=args.client_num_per_round,
             batch_size=args.batch_size, comm_round=args.comm_round, epochs=1,
             frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
+            pack_lanes=args.pack_lanes,
+            pack_capacity_factor=args.pack_capacity_factor,
         )
         _, hist = FedSim(trainer, train, test, cfg).run()
         evals = [(h["round"], h["Test/Acc"]) for h in hist if "Test/Acc" in h]
@@ -108,6 +110,16 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--lr", type=float, default=0.01)
     parser.add_argument("--comm_round", type=int, default=250)
     parser.add_argument("--frequency_of_the_test", type=int, default=25)
+    parser.add_argument("--pack_lanes", type=int, default=0,
+                        help="packed-lane cohort execution (docs/"
+                             "PERFORMANCE.md): N lanes per mesh shard "
+                             "bin-packed from the cohort's step streams "
+                             "instead of padding to the straggler max; "
+                             "0 = padded path (bit-identical either way)")
+    parser.add_argument("--pack_capacity_factor", type=float, default=1.25,
+                        help="lane-length head room over the expected "
+                             "per-shard cohort load (overflow spills to an "
+                             "extra sequential pass)")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--size_dist", type=str, default="lognormal",
                         choices=["lognormal", "uniform"],

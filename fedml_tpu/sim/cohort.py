@@ -6,6 +6,13 @@ for each round's cohort, gather the sampled clients' samples into one padded
 array stack ``[C, S, B, ...]`` (C clients × S steps × B batch) with an example
 mask, and ship it to device once. Shapes are identical every round, so the
 round program compiles exactly once.
+
+Two device layouts share this staging machinery: the padded layout above
+(one lane per client, padded to the cohort max — every client scans S_max
+steps), and the packed-lane layout (:func:`pack_cohort` /
+:func:`pack_index_map`, SimConfig.pack_lanes) that bin-packs the cohort's
+executed-step streams into L fixed-length lanes so skewed cohorts stop
+burning FLOPs on straggler padding (docs/PERFORMANCE.md).
 """
 
 from __future__ import annotations
@@ -185,6 +192,36 @@ def _cohort_index_map_loop(
     return idx.reshape(C, steps, batch_size), sizes.astype(np.float32)
 
 
+def gather_index_stack(
+    arrays: dict[str, np.ndarray], idx: np.ndarray
+) -> dict[str, np.ndarray]:
+    """Gather dataset rows through an index map (-1 = empty slot) with the
+    canonical zero-fill + example-mask semantics: empty slots are zero rows
+    with mask 0, and sequence tasks' per-token mask is combined with example
+    validity. ``idx`` may have ANY leading shape — [C, S, B] for the padded
+    cohort stack, [L, S_lane, B] for packed lanes — so both layouts share
+    ONE definition (the host mirror of ``FedSim._gather_batches``)."""
+    lead = idx.shape
+    flat = idx.reshape(-1)
+    valid = flat >= 0
+    safe = np.where(valid, flat, 0)
+    out: dict[str, np.ndarray] = {}
+    for name, arr in arrays.items():
+        gathered = arr[safe]
+        gathered[~valid] = 0  # empty slots are zero-filled, exactly as before
+        out[name] = gathered.reshape(lead + arr.shape[1:])
+    example_mask = valid.astype(np.float32).reshape(lead)
+    if "mask" in out:
+        # sequence tasks: combine per-token mask with example validity
+        tok = out["mask"].astype(np.float32)
+        out["mask"] = tok * example_mask.reshape(
+            example_mask.shape + (1,) * (tok.ndim - example_mask.ndim)
+        )
+    else:
+        out["mask"] = example_mask
+    return out
+
+
 def stack_cohort(
     data: FederatedArrays,
     client_ids: np.ndarray,
@@ -204,23 +241,181 @@ def stack_cohort(
     ships — one vectorized gather instead of a per-client copy loop.
     """
     idx, sizes = cohort_index_map(data, client_ids, batch_size, steps=steps, rng=rng)
-    C, S, B = idx.shape
-    flat = idx.reshape(C, S * B)
-    valid = flat >= 0
-    safe = np.where(valid, flat, 0).reshape(-1)
-    batch_stack: dict[str, np.ndarray] = {}
-    for name, arr in data.arrays.items():
-        gathered = arr[safe].reshape((C, S * B) + arr.shape[1:])
-        gathered[~valid] = 0  # empty slots are zero-filled, exactly as before
-        batch_stack[name] = gathered.reshape((C, S, B) + arr.shape[1:])
-    example_mask = valid.astype(np.float32).reshape(C, S, B)
-    if "mask" in batch_stack:
-        # sequence tasks: combine per-token mask with example validity
-        tok = batch_stack["mask"].astype(np.float32)
-        batch_stack["mask"] = tok * example_mask.reshape(example_mask.shape + (1,) * (tok.ndim - 3))
-    else:
-        batch_stack["mask"] = example_mask
-    return batch_stack, sizes
+    return gather_index_stack(data.arrays, idx), sizes
+
+
+# ---------------------------------------------------------------------------
+# Packed-lane execution planning (docs/PERFORMANCE.md "Packed-lane cohort
+# execution"): instead of one lane per client padded to the cohort max, the
+# cohort's per-client step streams are bin-packed into L fixed-length lanes,
+# so device FLOPs scale with the EXECUTED steps, not C x the straggler max.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class PackPass:
+    """One dispatch of the packed lane program: [L, S_lane] per-step plan.
+
+    ``slot``: global cohort slot executing at this lane step (-1 = lane tail
+    padding). ``gidx``: the step's global index e*S+s in the client's
+    epochs-x-steps chain — drives both the per-step rng-key gather and the
+    loss-buffer scatter, so skipped padding steps cannot shift the client's
+    rng stream. ``sidx``: the data-step row s into the round's [C, S, B]
+    cohort index map (epochs re-read the same rows, exactly as the padded
+    scan does). ``boundary``: 1 on the client's last executed step — the
+    round program emits the finished client's model into its update-stack
+    slot there and resets the lane carry to the global params."""
+
+    slot: np.ndarray      # [L, S_lane] int32
+    gidx: np.ndarray      # [L, S_lane] int32
+    sidx: np.ndarray      # [L, S_lane] int32
+    boundary: np.ndarray  # [L, S_lane] int32
+
+
+@dataclasses.dataclass(frozen=True)
+class PackPlan:
+    """A round's lane packing: one or more fixed-shape :class:`PackPass`
+    dispatches (overflow cohorts spill to extra sequential passes, keeping
+    every pass the same compiled program). ``total_steps`` counts executed
+    (data-carrying, in-budget) steps across the cohort; ``capacity`` is
+    ``len(passes) * lanes * s_lane`` — their ratio is the packed padding
+    fraction the bench reports."""
+
+    passes: tuple
+    lanes: int
+    s_lane: int
+    total_steps: int
+    capacity: int
+
+    @property
+    def padding_frac(self) -> float:
+        return 1.0 - self.total_steps / max(self.capacity, 1)
+
+
+def executed_steps(
+    num_steps: np.ndarray, data_steps: np.ndarray, steps_per_epoch: int,
+    epochs: int,
+) -> np.ndarray:
+    """[C, E] executed (parameter-changing) step counts per client per epoch:
+    a padded-scan step is a real step iff its batch row carries data
+    (``s < data_steps``) AND it is inside the client's straggler budget
+    (``e*S + s < num_steps``). Everything else is a masked no-op the packed
+    path exists to skip."""
+    S = int(steps_per_epoch)
+    num_steps = np.asarray(num_steps, np.int64)
+    data_steps = np.asarray(data_steps, np.int64)
+    budget = np.clip(
+        num_steps[:, None] - np.arange(int(epochs))[None, :] * S, 0, S
+    )
+    return np.minimum(np.maximum(data_steps, 0)[:, None], budget)
+
+
+def pack_cohort(
+    num_steps: np.ndarray,
+    data_steps: np.ndarray,
+    steps_per_epoch: int,
+    epochs: int,
+    lanes_per_shard: int,
+    s_lane: int,
+    n_shards: int = 1,
+) -> PackPlan:
+    """Greedy-LPT bin packing of the cohort's step streams into lanes.
+
+    Clients are packed PER MESH SHARD (slot block ``[d*c_local, (d+1)*
+    c_local)`` goes to lane block ``[d*lanes_per_shard, ...)``), so each
+    device's lanes only ever emit into its own update-stack block and the
+    packed program combines shards with the exact same ``all_gather`` the
+    padded program uses — no cross-device scatter arithmetic to perturb
+    bit-identity. Within a shard: longest-processing-time order, each client
+    onto the least-loaded lane that still fits; clients that fit no lane of
+    the current pass spill to a fresh pass (same shapes, extra sequential
+    dispatch). Pure numpy, O(total executed steps) like the CSR staging
+    machinery."""
+    num_steps = np.asarray(num_steps, np.int64)
+    C = len(num_steps)
+    if C % n_shards:
+        raise ValueError(f"cohort size {C} not divisible by {n_shards} shards")
+    c_local = C // n_shards
+    S = int(steps_per_epoch)
+    E = int(epochs)
+    per_epoch = executed_steps(num_steps, data_steps, S, E)
+    totals = per_epoch.sum(axis=1)
+    if (totals > s_lane).any():
+        bad = int(np.argmax(totals))
+        raise ValueError(
+            f"cohort slot {bad} needs {int(totals[bad])} steps but lanes are "
+            f"{s_lane} long — size s_lane to the population max"
+        )
+    L = lanes_per_shard * n_shards
+    # assign[p][lane] = clients (in placement order) for pass p
+    assign: list[list[list[int]]] = []
+    for shard in range(n_shards):
+        slots = np.arange(shard * c_local, (shard + 1) * c_local)
+        order = slots[np.argsort(-totals[slots], kind="stable")]
+        pending = [int(s) for s in order if totals[s] > 0]
+        p = 0
+        while pending:
+            while len(assign) <= p:
+                assign.append([[] for _ in range(L)])
+            loads = np.zeros(lanes_per_shard, np.int64)
+            lane_clients: list[list[int]] = [[] for _ in range(lanes_per_shard)]
+            nxt: list[int] = []
+            for s in pending:
+                lane = int(np.argmin(loads))
+                # the least-loaded lane not fitting means NO lane fits
+                if loads[lane] + totals[s] <= s_lane:
+                    loads[lane] += totals[s]
+                    lane_clients[lane].append(s)
+                else:
+                    nxt.append(s)
+            for li, clients in enumerate(lane_clients):
+                assign[p][shard * lanes_per_shard + li] = clients
+            pending = nxt
+            p += 1
+    passes = []
+    for p_assign in assign:
+        slot = np.full((L, s_lane), -1, np.int32)
+        gidx = np.zeros((L, s_lane), np.int32)
+        sidx = np.zeros((L, s_lane), np.int32)
+        boundary = np.zeros((L, s_lane), np.int32)
+        for li, clients in enumerate(p_assign):
+            pos = 0
+            for s in clients:
+                t = int(totals[s])
+                counts = per_epoch[s]
+                g = np.concatenate(
+                    [e * S + np.arange(c) for e, c in enumerate(counts)]
+                )
+                sx = np.concatenate([np.arange(c) for c in counts])
+                slot[li, pos:pos + t] = s
+                gidx[li, pos:pos + t] = g
+                sidx[li, pos:pos + t] = sx
+                boundary[li, pos + t - 1] = 1
+                pos += t
+        passes.append(PackPass(slot, gidx, sidx, boundary))
+    if not passes:  # an all-empty cohort still needs one (no-op) dispatch
+        passes.append(PackPass(
+            np.full((L, s_lane), -1, np.int32),
+            np.zeros((L, s_lane), np.int32),
+            np.zeros((L, s_lane), np.int32),
+            np.zeros((L, s_lane), np.int32),
+        ))
+    return PackPlan(
+        tuple(passes), L, int(s_lane), int(totals.sum()),
+        len(passes) * L * int(s_lane),
+    )
+
+
+def pack_index_map(idx: np.ndarray, pack_pass: PackPass) -> np.ndarray:
+    """Gather the round's [C, S, B] cohort index map into the packed
+    [L, S_lane, B] lane layout (-1 = empty slot). Lane steps read the exact
+    rows the padded scan would have read, so batch content is bit-identical
+    by construction."""
+    C, S, _ = idx.shape
+    safe_slot = np.clip(pack_pass.slot, 0, C - 1)
+    safe_s = np.clip(pack_pass.sidx, 0, S - 1)
+    out = idx[safe_slot, safe_s]
+    return np.where((pack_pass.slot >= 0)[..., None], out, -1).astype(np.int32)
 
 
 def batch_array(arrays: dict[str, np.ndarray], batch_size: int) -> dict[str, np.ndarray]:

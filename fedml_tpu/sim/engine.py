@@ -83,6 +83,22 @@ class SimConfig:
     # MXU without cross-client batching, so scan costs ~nothing and frees
     # C_local-1 clients' worth of HBM for longer sequences / bigger batches).
     cohort_execution: str = "vmap"
+    # Packed-lane execution (docs/PERFORMANCE.md "Packed-lane cohort
+    # execution"): 0 (default) = the padded [C, S_max] layout above; N > 0 =
+    # host staging bin-packs each round's per-client step streams into N
+    # fixed-length lanes PER MESH SHARD and the round program scans lanes,
+    # resetting its carry at client boundaries — device FLOPs scale with the
+    # cohort's executed steps instead of C x the straggler max, the big win
+    # on power-law populations where one client holds 10-100x the median.
+    # Bit-identical to the padded path (tools/pack_smoke.py guards this);
+    # requires broadcast-mode aggregation and the default cohort_execution.
+    pack_lanes: int = 0
+    # Lane length head-room over the expected per-shard cohort load. Lanes
+    # are sized ONCE (compile-once shapes): s_lane = max(population max
+    # client steps, ceil(factor * mean load / lanes)); a round whose draw
+    # overflows every lane spills the leftovers to an extra sequential pass
+    # of the same compiled program.
+    pack_capacity_factor: float = 1.25
     # Update compression (fedml_tpu/compress, docs/COMPRESSION.md): codec
     # spec for client->server updates — "none" keeps the dense bit-identical
     # path with no compression machinery in the program; "topk"/"q8"/"q4"/
@@ -110,6 +126,23 @@ class SimConfig:
     # capture an XLA trace of the round loop (SURVEY §5.1: jax.profiler is the
     # TPU equivalent of the reference's wandb/host tracing)
     profile_dir: str | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PackedStaged:
+    """A packed round's staged payload (SimConfig.pack_lanes > 0): one
+    device-resident plan per pass — (data, slot, gidx, boundary), where data
+    is the [L, S_lane, B] index map (on-device dataset) or the gathered
+    [L, S_lane, B, ...] batch stacks (host staging) — plus the cohort's
+    weights/budgets and the round rng key. ``stats`` carries host-side plan
+    accounting (n_passes / total_steps / capacity) for observability; it
+    never enters the jitted programs."""
+
+    passes: tuple
+    weights: Any
+    num_steps: Any
+    rkey: Any
+    stats: dict
 
 
 class FedSim:
@@ -209,6 +242,58 @@ class FedSim:
         self._rep = meshlib.replicated(self.mesh)
         self._shard = meshlib.cohort_batch_sharding(self.mesh)
         self._n_client_shards = self.mesh.shape[meshlib.CLIENT_AXIS]
+        if config.pack_lanes < 0:
+            # -1 is NOT "auto" here (unlike pipeline_depth): a negative lane
+            # count silently running the padded path would mislabel benchmarks
+            raise ValueError(
+                f"pack_lanes must be >= 0 (got {config.pack_lanes}); "
+                "0 disables packing"
+            )
+        self._pack = config.pack_lanes > 0
+        if self._pack:
+            if self._per_client:
+                raise ValueError(
+                    "pack_lanes resets lane carries to the BROADCAST global "
+                    "params at client boundaries; per-client aggregators "
+                    "(decentralized/gossip) need the padded path"
+                )
+            if config.cohort_execution == "scan":
+                raise ValueError(
+                    "pack_lanes replaces the cohort execution loop entirely; "
+                    "leave cohort_execution='vmap' (lanes are vmapped)"
+                )
+            if local_train_fn is not None:
+                raise ValueError(
+                    "pack_lanes drives ClientTrainer.train_step directly "
+                    "(boundary-aware lane steps) and cannot honor a custom "
+                    "local_train_fn (e.g. the GAN adversarial loop); use the "
+                    "padded path for custom round programs"
+                )
+            if config.block_dispatch:
+                raise ValueError(
+                    "pack_lanes and block_dispatch are mutually exclusive: "
+                    "packed rounds already dispatch one program per pass"
+                )
+            n_dev = self._n_client_shards
+            self._c_pad = -(-config.client_num_per_round // n_dev) * n_dev
+            # Fixed lane length (compile-once): fit the population's largest
+            # per-client step budget, with capacity-factor head room over the
+            # expected per-shard cohort load; overflow draws spill to extra
+            # sequential passes of the same compiled program.
+            sizes = train_data.client_sizes()
+            slots = self._steps * config.batch_size
+            d = np.ceil(
+                np.minimum(sizes, slots) / max(config.batch_size, 1)
+            ).astype(np.int64)
+            t = trainer.epochs * d
+            t_max = int(t.max()) if len(t) else 1
+            mean_t = float(t.mean()) if len(t) else 1.0
+            c_local = self._c_pad // n_dev
+            need = (
+                config.pack_capacity_factor * mean_t * c_local
+                / config.pack_lanes
+            )
+            self._s_lane = max(t_max, int(np.ceil(need)), 1)
         # multi-controller (jax.distributed) jobs: every process stages the
         # same host arrays but materializes only its addressable shards
         self._multihost = jax.process_count() > 1
@@ -260,7 +345,7 @@ class FedSim:
             if config.block_dispatch is not None
             else (self._on_device
                   and next(iter(self.mesh.devices.flat)).platform != "cpu")
-        ) and self._on_device
+        ) and self._on_device and not self._pack
         if self._on_device:
             self._dataset = self._put(
                 {k: np.asarray(v) for k, v in train_data.arrays.items()},
@@ -277,6 +362,63 @@ class FedSim:
                     check_vma=False,
                 ),
                 donate_argnums=self._donate,
+            )
+
+        if self._pack:
+            # Packed-lane programs (docs/PERFORMANCE.md): a zero-buffer init,
+            # a lane-scan pass (one per plan pass; the common draw needs one),
+            # and the aggregation program consuming the SAME [C_pad, ...]
+            # update stack the padded round builds.
+            from fedml_tpu.core.trainer import make_lane_step
+
+            self._lane_step = make_lane_step(trainer)
+            self._packed_buf_fn = jax.jit(
+                compat.shard_map(
+                    self._packed_buf_impl,
+                    mesh=self.mesh,
+                    in_specs=(P(),),
+                    out_specs=(cohort_spec,) * 4,
+                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                    check_vma=False,
+                )
+            )
+            if self._on_device:
+                pass_impl = self._packed_gather_pass_impl
+                pass_specs = (P(), P()) + (cohort_spec,) * 8 + (P(),)
+                buf_args = (6, 7, 8, 9)  # (stack, written, lbuf, wbuf)
+            else:
+                pass_impl = self._packed_host_pass_impl
+                pass_specs = (P(),) + (cohort_spec,) * 8 + (P(),)
+                buf_args = (5, 6, 7, 8)
+            # The chained round buffers are exclusively owned (built by the
+            # buf program, consumed once per pass, then by the aggregation) —
+            # donate them so passes update the stack in place instead of
+            # holding two [C_pad, model] copies live. Same legacy-lowering
+            # guard as self._donate (see the donation note above).
+            buf_donate = buf_args if hasattr(jax, "shard_map") else ()
+            self._packed_pass_fn = jax.jit(
+                compat.shard_map(
+                    pass_impl,
+                    mesh=self.mesh,
+                    in_specs=pass_specs,
+                    out_specs=(cohort_spec,) * 4,
+                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                    check_vma=False,
+                ),
+                donate_argnums=buf_donate,
+            )
+            self._packed_agg_fn = jax.jit(
+                compat.shard_map(
+                    self._packed_agg_impl,
+                    mesh=self.mesh,
+                    in_specs=(P(), P()) + (cohort_spec,) * 6 + (P(),),
+                    out_specs=(P(), P(), P()),
+                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
+                    check_vma=False,
+                ),
+                donate_argnums=(
+                    (2, 3, 4, 5) if hasattr(jax, "shard_map") else ()
+                ),
             )
 
         self._test_batches = None
@@ -371,12 +513,27 @@ class FedSim:
             local_vars, train_metrics = jax.vmap(
                 self._local_train, in_axes=(var_axis, 0, 0, 0)
             )(global_variables, batches, keys, num_steps)
+        return self._aggregate_tail(
+            global_variables, server_state, local_vars, weights, num_steps,
+            train_metrics["train_loss"], rng,
+        )
+
+    def _aggregate_tail(self, global_variables, server_state, local_vars,
+                        weights, num_steps, train_loss, rng):
+        # The round's server side, shared verbatim by the padded and packed
+        # execution modes: all_gather the cohort stack, derive tau, run the
+        # aggregation rule, and assemble round metrics. Runs per client-shard
+        # inside shard_map.
+        from fedml_tpu.parallel.mesh import CLIENT_AXIS
+
+        c_local = weights.shape[0]
+        shard_idx = jax.lax.axis_index(CLIENT_AXIS)
         # Full cohort stack for the aggregator (robust rules need every
         # client's model: median/krum/clipping are cross-client).
         gather = partial(jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True)
         stacked = jax.tree.map(gather, local_vars)
         all_weights = gather(weights)
-        all_losses = gather(train_metrics["train_loss"])
+        all_losses = gather(train_loss)
         # true per-client SGD steps τ_i = e_i · ceil(n_i / B) — heterogeneous
         # local work for normalized-averaging rules (FedNova τ_eff). The
         # static max_tau keeps the normalizer recursion's loop bound
@@ -454,6 +611,168 @@ class FedSim:
             global_variables, server_state, batches, weights, num_steps, rng
         )
 
+    # -- packed-lane execution (SimConfig.pack_lanes) ------------------------
+
+    def _packed_buf_impl(self, variables):
+        # Per-shard zero output buffers for one packed round: the update
+        # stack [c_local, ...], its written mask, and the per-(client, chain
+        # step) loss/weight scatter buffers the metrics are rebuilt from.
+        c_local = self._c_pad // self._n_client_shards
+        T = self.trainer.epochs * self._steps
+        stack = jax.tree.map(
+            lambda l: jnp.zeros((c_local,) + l.shape, l.dtype), variables
+        )
+        written = jnp.zeros((c_local,), jnp.float32)
+        lbuf = jnp.zeros((c_local, T), jnp.float32)
+        wbuf = jnp.zeros((c_local, T), jnp.float32)
+        return stack, written, lbuf, wbuf
+
+    def _packed_pass_body(self, variables, get_batch, data, slot, gidx,
+                          boundary, stack, written, lbuf, wbuf, rng):
+        # One lane-scan pass over this shard's [L_local, S_lane] plan. Each
+        # lane carries ONE client's training state at a time; `gidx` indexes
+        # the client's padded-scan step chain so rng keys and loss positions
+        # land exactly where the padded program would put them, and
+        # `boundary` steps emit the finished client into the update stack.
+        from fedml_tpu.parallel.mesh import CLIENT_AXIS
+
+        T = self.trainer.epochs * self._steps
+        c_local = written.shape[0]
+        l_local = slot.shape[0]
+        shard_idx = jax.lax.axis_index(CLIENT_AXIS)
+        base = shard_idx * c_local
+        slot_ids = base + jnp.arange(c_local)
+        # The EXACT per-client rng chains the padded scan walks: fold_in by
+        # global slot, then one split per epochs-x-steps scan step. Skipped
+        # padding steps still advance the chain (a threefry hash each, not a
+        # train step), so executed steps read identical step keys.
+        keys0 = jax.vmap(lambda i: jax.random.fold_in(rng, i))(slot_ids)
+
+        def chain(k):
+            def body(kk, _):
+                kk, s = jax.random.split(kk)
+                return kk, s
+
+            return jax.lax.scan(body, k, None, length=T)[1]
+
+        keys_full = jax.vmap(chain)(keys0)  # [c_local, T] step keys
+        opt0 = self.trainer.optimizer.init(variables["params"])
+        vstep = jax.vmap(self._lane_step, in_axes=(0, 0, None, None, 0, 0, 0))
+        broadcast = lambda tree: jax.tree.map(  # noqa: E731
+            lambda l: jnp.broadcast_to(
+                jnp.asarray(l)[None], (l_local,) + jnp.shape(l)
+            ),
+            tree,
+        )
+
+        def step(carry, xs):
+            lane_vars, lane_opt, stack, written, lbuf, wbuf = carry
+            slot_t, gidx_t, bound_t, data_t = xs
+            batch_t = get_batch(data_t)
+            # per-shard packing guarantees this shard's lanes only carry its
+            # own slot block; the range check is defensive (bad plans drop
+            # instead of corrupting a neighbor's slot)
+            ok = (slot_t >= base) & (slot_t < base + c_local)
+            lslot = jnp.clip(slot_t - base, 0, c_local - 1)
+            is_first = ok & (gidx_t == 0)
+            g = jnp.clip(gidx_t, 0, T - 1)
+            keys_t = keys_full[lslot, g]
+            lane_vars, lane_opt, loss, w = vstep(
+                lane_vars, lane_opt, variables, opt0, batch_t, keys_t,
+                is_first,
+            )
+            wr = jnp.where(ok, lslot, c_local)  # c_local is OOB -> dropped
+            lbuf = lbuf.at[wr, g].set(loss, mode="drop")
+            wbuf = wbuf.at[wr, g].set(w, mode="drop")
+            em = jnp.where(ok & (bound_t > 0), lslot, c_local)
+            stack = jax.tree.map(
+                lambda st, lv: st.at[em].set(lv, mode="drop"), stack,
+                lane_vars,
+            )
+            written = written.at[em].set(1.0, mode="drop")
+            return (lane_vars, lane_opt, stack, written, lbuf, wbuf), None
+
+        xs = (
+            jnp.swapaxes(slot, 0, 1),
+            jnp.swapaxes(gidx, 0, 1),
+            jnp.swapaxes(boundary, 0, 1),
+            jax.tree.map(lambda a: jnp.swapaxes(a, 0, 1), data),
+        )
+        carry = (broadcast(variables), broadcast(opt0), stack, written,
+                 lbuf, wbuf)
+        (_, _, stack, written, lbuf, wbuf), _ = scanlib.scan(step, carry, xs)
+        return stack, written, lbuf, wbuf
+
+    def _packed_host_pass_impl(self, variables, batches, slot, gidx, boundary,
+                               stack, written, lbuf, wbuf, rng):
+        # host-staged variant: `batches` leaves are [L_local, S_lane, B, ...]
+        return self._packed_pass_body(
+            variables, lambda b: b, batches, slot, gidx, boundary, stack,
+            written, lbuf, wbuf, rng,
+        )
+
+    def _packed_gather_pass_impl(self, variables, dataset, idx, slot, gidx,
+                                 boundary, stack, written, lbuf, wbuf, rng):
+        # on-device-dataset variant: `idx` is [L_local, S_lane, B], gathered
+        # per step with the one canonical batch-gather definition
+        return self._packed_pass_body(
+            variables, lambda i: self._gather_batches(dataset, i), idx, slot,
+            gidx, boundary, stack, written, lbuf, wbuf, rng,
+        )
+
+    def _packed_agg_impl(self, variables, server_state, stack, written, lbuf,
+                         wbuf, weights, num_steps, rng):
+        # Rebuild exactly the padded round's per-client quantities from the
+        # pass buffers, then run the shared aggregation tail. Unwritten slots
+        # (zero-weight cohort padding) select the global variables — the same
+        # bits the padded path's fully-masked scan leaves there.
+        E, S = self.trainer.epochs, self._steps
+        c_local = weights.shape[0]
+        local_vars = jax.tree.map(
+            lambda st, g: jnp.where(
+                written.reshape((c_local,) + (1,) * g.ndim) > 0, st, g[None]
+            ),
+            stack, variables,
+        )
+        # The padded program's per-epoch loss sum is `jnp.sum(losses * ws)`
+        # over the step scan's ys — and its SUMMATION ORDER depends on how
+        # that scan lowered: straight-lined (scanlib's CPU mode) the stack
+        # of per-step scalars fuses into a left-to-right add chain; rolled,
+        # it is an XLA Reduce. The two differ by ULPs (measured), so
+        # reproduce whichever form the padded local_train compiled to,
+        # using scanlib's own unroll predicate.
+        prods = (lbuf * wbuf).reshape(c_local, E, S)
+        wres = wbuf.reshape(c_local, E, S)
+        chained = (
+            jax.default_backend() == "cpu"
+            and 0 < E <= scanlib.UNROLL_CAP
+            and S <= scanlib.UNROLL_CAP // E
+        )
+
+        def epoch_sums(mat):  # [c_local, E, S] -> [c_local, E]
+            if chained:
+                acc = mat[:, :, 0]
+                for s in range(1, S):
+                    acc = acc + mat[:, :, s]
+                return acc
+            return jnp.stack(
+                [jnp.sum(mat[:, e, :], axis=-1) for e in range(E)], axis=1
+            )
+
+        loss_sums = epoch_sums(prods)
+        w_sums = epoch_sums(wres)
+        last = jnp.maximum(
+            jnp.minimum((num_steps.astype(jnp.int32) - 1) // S, E - 1), 0
+        )
+        rows = jnp.arange(c_local)
+        train_loss = loss_sums[rows, last] / jnp.maximum(
+            w_sums[rows, last], 1.0
+        )
+        return self._aggregate_tail(
+            variables, server_state, local_vars, weights, num_steps,
+            train_loss, rng,
+        )
+
     def _block_impl(self, global_variables, server_state, dataset, idxs,
                     weights, num_steps, rngs):
         # R stacked rounds in one program: lax.scan over the round axis of
@@ -528,6 +847,11 @@ class FedSim:
         prefetch thread); default stages inline."""
         if not self._on_device:
             raise ValueError("run_block requires the on-device dataset path")
+        if self._pack:
+            raise ValueError(
+                "run_block is the padded block-dispatch path; packed rounds "
+                "(pack_lanes > 0) dispatch one program per pass instead"
+            )
         idxs, weights, num_steps, rngs = (
             staged if staged is not None
             else self._stage_block(start_round, n_rounds, root_rng)
@@ -712,15 +1036,93 @@ class FedSim:
     def stage_cohort_round(self, cohort, round_idx: int, rkey):
         """Staged payload for one round over an explicit cohort (the
         on-device index map or the host batch stack, + weights, budgets,
-        and the round's rng key)."""
+        and the round's rng key; a :class:`PackedStaged` lane plan when
+        packed execution is on)."""
+        if self._pack:
+            return self._stage_packed_round(cohort, round_idx, rkey)
         if self._on_device:
             staged = self.stage_cohort_indices(cohort, round_idx)
         else:
             staged = self.stage_cohort(cohort, round_idx)
         return staged + (rkey,)
 
+    def _pack_round_plan(self, cohort, round_idx: int):
+        """Host-only planning for one packed round: the round's [C_pad, S, B]
+        cohort index map (built exactly as the padded path builds it) plus
+        the lane packing of each client's executed-step stream. No device
+        work — stats consumers (bench probes) read plans without staging."""
+        idx, weights, num_steps = self._host_cohort_indices(cohort, round_idx)
+        if len(weights) != self._c_pad:
+            raise ValueError(
+                f"packed execution compiled for {self._c_pad} cohort slots "
+                f"but this cohort stages {len(weights)} — compositions that "
+                "pick their own cohort sizes (e.g. hierarchical groups) "
+                "need the padded path"
+            )
+        B = self.config.batch_size
+        valid_counts = (idx >= 0).reshape(len(weights), -1).sum(axis=1)
+        data_steps = -(-valid_counts // B)
+        plan = cohortlib.pack_cohort(
+            num_steps, data_steps, self._steps, self.trainer.epochs,
+            self.config.pack_lanes, self._s_lane, self._n_client_shards,
+        )
+        return idx, weights, num_steps, plan
+
+    def pack_round_stats(self, round_idx: int) -> dict:
+        """Plan accounting for the round the engine would actually run
+        (its sampled cohort, its budgets): pass count, executed steps, lane
+        capacity, and the padded path's scanned-step count — all host-side,
+        nothing shipped to device."""
+        _, weights, _, plan = self._pack_round_plan(
+            self._sample_round_cohort(round_idx), round_idx
+        )
+        return {
+            "n_passes": len(plan.passes),
+            "total_steps": plan.total_steps,
+            "capacity": plan.capacity,
+            "padded_steps": len(weights) * self.trainer.epochs * self._steps,
+        }
+
+    def _stage_packed_round(self, cohort, round_idx: int, rkey) -> PackedStaged:
+        """Host staging for one packed round: plan it (:meth:`_pack_round_plan`),
+        gather each pass's data, and ship plan + data to device. Pure in
+        (config, round_idx, rkey) like every staging path, so the prefetch
+        thread can run it ahead."""
+        idx, weights, num_steps, plan = self._pack_round_plan(cohort, round_idx)
+        lane_shard = meshlib.client_sharded(self.mesh)
+        passes = []
+        for pp in plan.passes:
+            pidx = cohortlib.pack_index_map(idx, pp)
+            if self._on_device:
+                data = self._put(pidx, lane_shard)
+            else:
+                data = self._put(
+                    cohortlib.gather_index_stack(self.train_data.arrays, pidx),
+                    lane_shard,
+                )
+            passes.append((
+                data,
+                self._put(pp.slot, lane_shard),
+                self._put(pp.gidx, lane_shard),
+                self._put(pp.boundary, lane_shard),
+            ))
+        return PackedStaged(
+            passes=tuple(passes),
+            weights=self._put(weights, lane_shard),
+            num_steps=self._put(num_steps, lane_shard),
+            rkey=rkey,
+            stats={
+                "n_passes": len(plan.passes),
+                "total_steps": plan.total_steps,
+                "capacity": plan.capacity,
+                "padded_steps": len(weights) * self.trainer.epochs * self._steps,
+            },
+        )
+
     def run_staged_round(self, staged, global_variables, server_state):
         """Dispatch one round from a stage_round payload."""
+        if isinstance(staged, PackedStaged):
+            return self._run_packed(staged, global_variables, server_state)
         data, weights, num_steps, rkey = staged
         if self._on_device:
             return self._gather_round_fn(
@@ -730,6 +1132,42 @@ class FedSim:
         return self._round_fn(
             global_variables, server_state, data, weights, num_steps, rkey
         )
+
+    def _run_packed(self, staged: PackedStaged, global_variables, server_state):
+        """One packed round: zero buffers, P lane-scan passes chaining the
+        update stack, then the aggregation program. All dispatches enqueue
+        asynchronously, so the extra program boundaries cost no host sync."""
+        bufs = self._packed_buf_fn(global_variables)
+        for data, slot, gidx, boundary in staged.passes:
+            if self._on_device:
+                bufs = self._packed_pass_fn(
+                    global_variables, self._dataset, data, slot, gidx,
+                    boundary, *bufs, staged.rkey,
+                )
+            else:
+                bufs = self._packed_pass_fn(
+                    global_variables, data, slot, gidx, boundary, *bufs,
+                    staged.rkey,
+                )
+        return self._packed_agg_fn(
+            global_variables, server_state, *bufs, staged.weights,
+            staged.num_steps, staged.rkey,
+        )
+
+    def pack_summary(self) -> dict:
+        """Static packed-execution accounting (empty when pack_lanes is off):
+        lane geometry and the padded-path step count one round would have
+        scanned — the observability hook exp loops log at run start."""
+        if not self._pack:
+            return {}
+        return {
+            "pack_lanes": self.config.pack_lanes,
+            "s_lane": self._s_lane,
+            "lane_capacity_per_pass":
+                self.config.pack_lanes * self._n_client_shards * self._s_lane,
+            "padded_scan_steps":
+                self._c_pad * self.trainer.epochs * self._steps,
+        }
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):
         return self.run_staged_round(

@@ -14,7 +14,10 @@ This module overlaps them:
   pure function of ``(config, round_idx, root_rng)`` — cohort sampling and
   shuffling are seeded per round — so prefetch order cannot change cohorts,
   rng keys, or metrics: the pipelined driver is bit-identical to the serial
-  one.
+  one. The staged payload is opaque to this module: padded rounds ship
+  (data, weights, budgets, key) tuples, packed-lane rounds
+  (SimConfig.pack_lanes) ship an ``engine.PackedStaged`` whose lane plan —
+  bin-packing included — was likewise built on this thread.
 - :class:`MetricsDrain` keeps each round's metrics as device arrays in a
   bounded queue and fetches them a round behind, so the driver only
   synchronizes with the device at eval boundaries and at the end of the run.

@@ -16,6 +16,23 @@ from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
 
 
 
+def _libc_shm_open():
+    """The process-wide ``shm_open`` symbol, or None when this container's
+    libc doesn't export it. glibc >= 2.34 folds POSIX shm into libc proper;
+    older glibc keeps it in librt — try both before concluding the forge-a-
+    stale-segment tests can't run here (the native ring itself links librt,
+    so only tests that call shm_open THEMSELVES via ctypes need this)."""
+    import ctypes
+
+    for lib in (None, "librt.so.1"):
+        try:
+            fn = getattr(ctypes.CDLL(lib, use_errno=True), "shm_open")
+        except (OSError, AttributeError):
+            continue
+        return fn
+    return None
+
+
 def _free_port_run(n: int = 1) -> int:
     """Base of a run of ``n`` consecutive free ports (all probed)."""
     import socket
@@ -126,6 +143,11 @@ def test_shm_ring_native():
         ring.unlink()
 
 
+@pytest.mark.skipif(
+    _libc_shm_open() is None,
+    reason="shm_open not exported by this container's libc or librt "
+           "(ctypes cannot forge the stale segment this test needs)",
+)
 def test_shm_ring_stale_segment_recovery():
     """A creator that died between O_EXCL and magic publication leaves a
     half-initialized segment; shmring_create must elect a single recoverer,
@@ -142,14 +164,15 @@ def test_shm_ring_stale_segment_recovery():
 
     # forge a half-initialized segment: right size, magic never published
     libc = ctypes.CDLL(None, use_errno=True)
-    fd = libc.shm_open(name.encode(), 0o102, 0o600)  # O_CREAT|O_RDWR
+    shm_open = _libc_shm_open()
+    fd = shm_open(name.encode(), 0o102, 0o600)  # O_CREAT|O_RDWR
     assert fd >= 0
     libc.ftruncate(fd, 1 << 16)
     libc.close(fd)
 
     # also forge a leftover recovery-lock segment (a dead recoverer's flock
     # was already released by the kernel — the segment alone must not block)
-    lfd = libc.shm_open(f"{name}.rec".encode(), 0o102, 0o600)
+    lfd = shm_open(f"{name}.rec".encode(), 0o102, 0o600)
     assert lfd >= 0
     libc.close(lfd)
 
@@ -162,7 +185,7 @@ def test_shm_ring_stale_segment_recovery():
             ring.close()
             ring.unlink()
         # shmring_unlink cleans up the recovery lock segment too
-        assert libc.shm_open(f"{name}.rec".encode(), 2, 0o600) < 0  # O_RDWR
+        assert shm_open(f"{name}.rec".encode(), 2, 0o600) < 0  # O_RDWR
     finally:
         del os.environ["FEDML_SHMRING_WAIT_MS"]
 

@@ -83,7 +83,12 @@ def test_splitnn_stepwise_matches_single_program():
     cv2, sv2, l2 = run_splitnn_relay_stepwise(split, cb, epochs=2, rng=jax.random.key(0))
     assert_trees_equal(sv1, sv2, "server vars")
     assert_trees_equal(cv1, cv2, "client vars")
-    assert l1 == l2
+    # variables ARE bit-equal (asserted above), but the reported per-step
+    # losses cross a jitted-scan vs per-step-program boundary where XLA:CPU
+    # fuses the loss reduction differently — ULP-level drift on some
+    # containers. rtol 1e-6 ~ a few f32 ULPs at these magnitudes; anything
+    # real (wrong step order, stale activations) is orders larger.
+    np.testing.assert_allclose(l1, l2, rtol=1e-6, atol=1e-7)
 
 
 def test_splitnn_loopback_matches_stepwise():
