@@ -304,6 +304,77 @@ def bench_pipeline_ab(trainer, train, test, cfg, n_rounds: int):
     return rps(None), rps(0)
 
 
+TRACE_PROBE_ROUNDS = 40  # tracer-overhead probe length (pipelined LR rounds)
+
+
+def bench_trace_overhead(n_rounds: int = TRACE_PROBE_ROUNDS):
+    """Tracer-overhead probe (fedml_tpu/obs/trace.py): rounds/sec through
+    the pipelined FedSim.run() loop with the process tracer installed vs
+    the default no-op path, on a small LR config where host-side per-round
+    overhead is the largest relative share (a heavy model would hide it).
+    The disabled figure is the configuration every other bench number runs
+    in — instrumentation with no tracer installed must cost ~nothing; the
+    enabled overhead is the price of recording. Returns probe metrics."""
+    import numpy as np
+
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import trace
+    from fedml_tpu.sim.cohort import FederatedArrays
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    C, B, F, K, n_per = 16, 16, 32, 4, 64
+    rng = np.random.RandomState(0)
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(C)}
+    train = FederatedArrays(
+        {"x": rng.rand(C * n_per, F).astype(np.float32),
+         "y": rng.randint(0, K, C * n_per).astype(np.int32)},
+        part,
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=K),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=n_rounds, epochs=1, frequency_of_the_test=10_000,
+        shuffle_each_round=False, seed=0, block_dispatch=False,
+        pipeline_depth=1,
+    )
+    sim = FedSim(trainer, train, None, cfg)
+    sim.run()  # compile + warm (shared by both arms: same programs)
+
+    def rps(traced: bool):
+        # best of 3 windows: host-dominated microbenchmark, so take the
+        # least load-disturbed window (same policy as bench_stage_probe)
+        best, tracer = 0.0, None
+        for _trial in range(3):
+            tracer = trace.install() if traced else None
+            try:
+                t0 = time.perf_counter()
+                _, hist = sim.run()
+                dt = time.perf_counter() - t0
+            finally:
+                if traced:
+                    trace.uninstall()
+            best = max(best, len(hist) / dt)
+        return best, tracer
+
+    disabled, _ = rps(False)
+    enabled, tracer = rps(True)
+    return {
+        "trace_probe_rounds": n_rounds,
+        "trace_disabled_rounds_per_sec": round(disabled, 3),
+        "trace_enabled_rounds_per_sec": round(enabled, 3),
+        "trace_enabled_overhead_pct": round(
+            100.0 * (disabled - enabled) / disabled, 2
+        ),
+        "trace_events_per_round": round(len(tracer.events()) / n_rounds, 1),
+    }
+
+
 PACK_CLIENTS = 256  # the packed-lane probe's Zipf cohort size
 PACK_LANES = 16
 
@@ -734,6 +805,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_pack_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["pack_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_trace_probe"
+    try:
+        pipeline_extra.update(bench_trace_overhead())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["trace_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_stage_probe"
     try:

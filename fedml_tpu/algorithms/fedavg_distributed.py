@@ -457,26 +457,29 @@ class CompressedFedAvgClientManager(FedAvgClientManager):
         from fedml_tpu.comm.message import pack_encoded_update
         from fedml_tpu.compress import error_feedback as eflib
         from fedml_tpu.core import tree as treelib
+        from fedml_tpu.obs import trace
 
         key = jax.random.fold_in(
             jax.random.key(0xC0DEC ^ self.rank), self._round
         )
-        if self.codec.delta_domain:
-            delta = treelib.tree_sub(new_vars, global_vars)
-            if self.error_feedback:
-                comp = eflib.compensate(
-                    delta, self._residuals.get(self._client_idx)
-                )
-                enc, _, new_residual = self._encode_ef(comp, key)
-                self._residuals[self._client_idx] = new_residual
+        with trace.span("compress/encode", scheme=self.codec.name,
+                        error_feedback=self.error_feedback):
+            if self.codec.delta_domain:
+                delta = treelib.tree_sub(new_vars, global_vars)
+                if self.error_feedback:
+                    comp = eflib.compensate(
+                        delta, self._residuals.get(self._client_idx)
+                    )
+                    enc, _, new_residual = self._encode_ef(comp, key)
+                    self._residuals[self._client_idx] = new_residual
+                else:
+                    # skip the EF program entirely: its jitted outputs
+                    # include a dense decode + residual that XLA cannot DCE,
+                    # all shipped to host just to be discarded
+                    enc = self._encode_plain(delta, key)
             else:
-                # skip the EF program entirely: its jitted outputs include a
-                # dense decode + residual that XLA cannot DCE, all shipped
-                # to host just to be discarded
-                enc = self._encode_plain(delta, key)
-        else:
-            enc = self._encode_plain(new_vars, key)
-        flat, desc = pack_encoded_update(enc)
+                enc = self._encode_plain(new_vars, key)
+            flat, desc = pack_encoded_update(enc)
         out.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE, flat)
         out.add_params(Message.MSG_ARG_KEY_ENCODED_DESC, desc)
 

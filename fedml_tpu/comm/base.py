@@ -13,6 +13,8 @@ from __future__ import annotations
 import abc
 from typing import TYPE_CHECKING
 
+from fedml_tpu.obs import trace
+
 if TYPE_CHECKING:
     from fedml_tpu.comm.message import Message
 
@@ -33,8 +35,17 @@ class BaseCommunicationManager(abc.ABC):
         self._observers.remove(observer)
 
     def notify(self, msg: "Message") -> None:
-        for obs in list(self._observers):
-            obs.receive_message(msg.get_type(), msg)
+        tracer = trace.get()
+        if tracer is None:  # disabled path: skip the payload-size walk too
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
+            return
+        with tracer.span("comm/recv", msg_type=msg.get_type(),
+                         sender=msg.get_sender_id(),
+                         receiver=msg.get_receiver_id(),
+                         bytes=msg.payload_nbytes()):
+            for obs in list(self._observers):
+                obs.receive_message(msg.get_type(), msg)
 
     @abc.abstractmethod
     def send_message(self, msg: "Message") -> None: ...

@@ -15,6 +15,7 @@ from typing import Callable
 
 from fedml_tpu.comm.base import BaseCommunicationManager, Observer
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs import trace
 
 
 def create_backend(backend: str, rank: int, world_size: int, **kw) -> BaseCommunicationManager:
@@ -78,10 +79,19 @@ class DistributedManager(Observer):
         if handler is None:
             logging.warning("rank %d: no handler for msg type %s", self.rank, msg_type)
             return
-        handler(msg)
+        with trace.span("comm/handler", msg_type=msg_type, rank=self.rank):
+            handler(msg)
 
     def send_message(self, msg: Message) -> None:
-        self.comm.send_message(msg)
+        tracer = trace.get()
+        if tracer is None:  # disabled path: skip the payload-size walk too
+            self.comm.send_message(msg)
+            return
+        with tracer.span("comm/send", msg_type=msg.get_type(),
+                         sender=self.rank,
+                         receiver=msg.get_receiver_id(),
+                         bytes=msg.payload_nbytes()):
+            self.comm.send_message(msg)
 
     def register_message_receive_handlers(self) -> None:
         raise NotImplementedError

@@ -63,6 +63,17 @@ class Message:
     def get(self, key: str, default=None) -> Any:
         return self.msg_params.get(key, default)
 
+    def payload_nbytes(self) -> int:
+        """Array-payload size in bytes (the dominant wire cost; the JSON
+        header adds a few hundred bytes on top). Cheap — sums ``nbytes``
+        over array params without serializing — so the tracing layer can
+        attach it to send/receive spans without re-packing the message."""
+        n = 0
+        for v in self.msg_params.values():
+            if isinstance(v, (np.ndarray, jax.Array)):
+                n += int(v.nbytes)
+        return n
+
     # --- wire format: JSON header + raw array segments ---
     MAGIC = b"FTM1"
 

@@ -29,6 +29,7 @@ from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
 from fedml_tpu.compress import error_feedback as ef
 from fedml_tpu.compress.codec import Codec, EncodedUpdate, tree_bytes
 from fedml_tpu.obs import metrics as metricslib
+from fedml_tpu.obs import trace
 
 Pytree = Any
 
@@ -132,20 +133,25 @@ def accumulate_encoded(
     O(k) work and no dense materialization per client. Other schemes decode
     one client at a time (one transient dense vector, never C of them).
     """
-    if enc.scheme == "topk" and not isinstance(
-        enc.planes.get("values"), EncodedUpdate
-    ):
-        vals = _flat_leaves(enc.planes["values"])
-        idxs = _flat_leaves(enc.planes["indices"])
+    # traced (hot only on the message-passing server, once per upload); the
+    # sim engine's encode/decode is fused into the round program and shows
+    # up inside engine/dispatch instead (docs/OBSERVABILITY.md)
+    with trace.span("compress/accumulate", scheme=enc.scheme):
+        if enc.scheme == "topk" and not isinstance(
+            enc.planes.get("values"), EncodedUpdate
+        ):
+            vals = _flat_leaves(enc.planes["values"])
+            idxs = _flat_leaves(enc.planes["indices"])
+            off = 0
+            for v, idx, spec in zip(vals, idxs, enc.meta_dict()["leaves"]):
+                n = int(np.prod(spec["shape"])) if spec["shape"] else 1
+                np.add.at(acc, off + idx.astype(np.int64),
+                          weight * v.astype(np.float64))
+                off += n
+            return
+        with trace.span("compress/decode", scheme=enc.scheme):
+            dense = _flat_leaves(codec.decode(enc))
         off = 0
-        for v, idx, spec in zip(vals, idxs, enc.meta_dict()["leaves"]):
-            n = int(np.prod(spec["shape"])) if spec["shape"] else 1
-            np.add.at(acc, off + idx.astype(np.int64),
-                      weight * v.astype(np.float64))
-            off += n
-        return
-    dense = _flat_leaves(codec.decode(enc))
-    off = 0
-    for leaf in dense:
-        acc[off : off + leaf.size] += weight * leaf.astype(np.float64)
-        off += leaf.size
+        for leaf in dense:
+            acc[off : off + leaf.size] += weight * leaf.astype(np.float64)
+            off += leaf.size

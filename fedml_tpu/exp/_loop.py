@@ -29,6 +29,8 @@ import logging
 import os
 import time
 
+from fedml_tpu.obs import trace
+
 
 def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
                stop_when=None) -> tuple[list, float]:
@@ -82,36 +84,37 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
 
             for r in range(cfg.comm_round):
                 try:
-                    if prefetch is not None:
-                        variables, server_state, m = sim.run_staged_round(
-                            prefetch.get(r), variables, server_state
-                        )
-                    else:
-                        variables, server_state, m = sim.run_round(
-                            r, variables, server_state, root
-                        )
-                    evaled = (r + 1) % freq == 0 or r == cfg.comm_round - 1
-                    if drain is not None:
-                        # non-blocking: queue this round's metrics on device,
-                        # fetch whatever fell off the back; evals force a
-                        # full flush (the host syncs there anyway)
-                        ready = drain.push(r, m)
-                        if evaled:
-                            ready = ready + drain.flush()
-                    else:
-                        ready = [(r, m)]
-                    # completed rounds go on the record BEFORE eval runs: an
-                    # eval failure must not lose rounds that trained fine
-                    # (only the current round's record rides on its eval,
-                    # exactly as in the serial driver)
-                    current = None
-                    for rr, mm in ready:
-                        if evaled and rr == r:
-                            current = mm
+                    with trace.span("loop/round", round=r):
+                        if prefetch is not None:
+                            variables, server_state, m = sim.run_staged_round(
+                                prefetch.get(r), variables, server_state
+                            )
                         else:
-                            write(rr, mm)
-                    if evaled:
-                        write(r, current, sim.eval_record(variables))
+                            variables, server_state, m = sim.run_round(
+                                r, variables, server_state, root
+                            )
+                        evaled = (r + 1) % freq == 0 or r == cfg.comm_round - 1
+                        if drain is not None:
+                            # non-blocking: queue this round's metrics on
+                            # device, fetch whatever fell off the back; evals
+                            # force a full flush (the host syncs there anyway)
+                            ready = drain.push(r, m)
+                            if evaled:
+                                ready = ready + drain.flush()
+                        else:
+                            ready = [(r, m)]
+                        # completed rounds go on the record BEFORE eval runs:
+                        # an eval failure must not lose rounds that trained
+                        # fine (only the current round's record rides on its
+                        # eval, exactly as in the serial driver)
+                        current = None
+                        for rr, mm in ready:
+                            if evaled and rr == r:
+                                current = mm
+                            else:
+                                write(rr, mm)
+                        if evaled:
+                            write(r, current, sim.eval_record(variables))
                 except Exception:
                     logging.exception(
                         "round %d failed — reporting the %d completed rounds",
@@ -142,8 +145,9 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
             # fine; the partial report should include them
             if drain is not None:
                 try:
-                    for rr, mm in drain.flush():
-                        write(rr, mm)
+                    with trace.span("loop/salvage_flush"):
+                        for rr, mm in drain.flush():
+                            write(rr, mm)
                 except Exception:
                     logging.exception(
                         "draining pending round metrics failed"

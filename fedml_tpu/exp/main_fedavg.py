@@ -145,6 +145,9 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="capture a jax.profiler trace of the round loop")
     # observability
+    from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
+
+    add_trace_cli_flag(parser)
     parser.add_argument("--run_dir", type=str, default=None)
     parser.add_argument("--enable_wandb", type=int, default=0)
     parser.add_argument("--checkpoint_dir", type=str, default=None)
@@ -359,6 +362,12 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
 
 
 def run(args) -> list[dict]:
+    from fedml_tpu.obs.trace import run_traced
+
+    return run_traced(_run, args)
+
+
+def _run(args) -> list[dict]:
     import jax
 
     from fedml_tpu.data import load_partition_data

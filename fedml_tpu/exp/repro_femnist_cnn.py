@@ -23,6 +23,12 @@ from pathlib import Path
 
 
 def run(args) -> dict:
+    from fedml_tpu.obs.trace import run_traced
+
+    return run_traced(_run, args)
+
+
+def _run(args) -> dict:
     import optax
 
     from fedml_tpu.core.trainer import ClientTrainer
@@ -164,6 +170,8 @@ Reproduce with: `python -m fedml_tpu.exp.repro_femnist_cnn --out REPRO.md`
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
+
     parser.add_argument("--data_dir", type=str, default="./data/femnist")
     parser.add_argument("--client_num_in_total", type=int, default=3400)
     parser.add_argument("--client_num_per_round", type=int, default=10)
@@ -181,6 +189,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="lane-length head room over the expected "
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
+    add_trace_cli_flag(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--metrics_out", type=str, default="repro_femnist_metrics.jsonl")
     parser.add_argument("--out", type=str, default="REPRO.md")

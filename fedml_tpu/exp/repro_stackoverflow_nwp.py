@@ -32,6 +32,12 @@ from pathlib import Path
 
 
 def run(args) -> dict:
+    from fedml_tpu.obs.trace import run_traced
+
+    return run_traced(_run, args)
+
+
+def _run(args) -> dict:
     import optax
 
     from fedml_tpu.core.trainer import ClientTrainer
@@ -240,6 +246,8 @@ Reproduce with: `python -m fedml_tpu.exp.repro_stackoverflow_nwp --test_clients 
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
+
     parser.add_argument("--data_dir", type=str,
                         default="./data/stackoverflow_nwp")
     parser.add_argument("--client_num_in_total", type=int, default=342_477)
@@ -268,6 +276,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="lane-length head room over the expected "
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
+    add_trace_cli_flag(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--train_eval_samples", type=int, default=50_000,
                         help="cap the pooled-train eval subset (None/0 = "

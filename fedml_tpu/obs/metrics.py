@@ -17,6 +17,8 @@ import time
 from pathlib import Path
 from typing import Any
 
+from fedml_tpu.obs import trace
+
 
 # Canonical bytes-on-wire metric keys (compress subsystem): actual bytes
 # that crossed (or would cross) the transport vs the dense-f32 equivalent,
@@ -28,6 +30,10 @@ COMM_UPLINK_DENSE_BYTES = "Comm/UplinkDenseBytes"
 COMM_DOWNLINK_BYTES = "Comm/DownlinkBytes"
 COMM_DOWNLINK_DENSE_BYTES = "Comm/DownlinkDenseBytes"
 COMM_RATIO = "Comm/CompressionRatio"
+COMM_DOWNLINK_RATIO = "Comm/DownlinkCompressionRatio"
+
+# ratio keys are derived, not additive — totals() must never sum them
+_RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
 
 
 class CommBytesAccountant:
@@ -71,6 +77,8 @@ class CommBytesAccountant:
             }
             if self._up:
                 rec[COMM_RATIO] = self._up_dense / self._up
+            if self._down:
+                rec[COMM_DOWNLINK_RATIO] = self._down_dense / self._down
             self.rounds.append(rec)
             self._up = self._up_dense = self._down = self._down_dense = 0
             return rec
@@ -89,11 +97,15 @@ class CommBytesAccountant:
             rounds = list(self.rounds)
         for rec in rounds + [pending]:
             for k, v in rec.items():
-                if k.startswith("Comm/") and k != COMM_RATIO:
+                if k.startswith("Comm/") and k not in _RATIO_KEYS:
                     out[k] = out.get(k, 0) + v
         if out.get(COMM_UPLINK_BYTES):
             out[COMM_RATIO] = (
                 out[COMM_UPLINK_DENSE_BYTES] / out[COMM_UPLINK_BYTES]
+            )
+        if out.get(COMM_DOWNLINK_BYTES):
+            out[COMM_DOWNLINK_RATIO] = (
+                out[COMM_DOWNLINK_DENSE_BYTES] / out[COMM_DOWNLINK_BYTES]
             )
         return out
 
@@ -107,12 +119,17 @@ def logging_config(process_id: int = 0, level=logging.INFO) -> None:
     )
 
 class MetricsLogger:
-    """wandb-key-compatible metric sink (Train/Acc, Test/Acc, ... by round)."""
+    """wandb-key-compatible metric sink (Train/Acc, Test/Acc, ... by round).
+
+    Usable as a context manager — the JSONL handle is closed even when the
+    run body raises. ``close()`` is idempotent; ``log()`` after close raises
+    instead of writing to a closed handle."""
 
     def __init__(self, run_dir: str | Path | None = None, use_wandb: bool = False,
                  wandb_kwargs: dict | None = None):
         self.run_dir = Path(run_dir) if run_dir else None
         self._fh = None
+        self._closed = False
         if self.run_dir:
             self.run_dir.mkdir(parents=True, exist_ok=True)
             self._fh = open(self.run_dir / "metrics.jsonl", "a")
@@ -128,6 +145,11 @@ class MetricsLogger:
         self.history: list[dict[str, Any]] = []
 
     def log(self, metrics: dict[str, Any], round_idx: int | None = None) -> None:
+        if self._closed:
+            raise RuntimeError(
+                "MetricsLogger.log() after close(): the JSONL sink is gone; "
+                "records logged here would be silently lost"
+            )
         rec = dict(metrics)
         if round_idx is not None:
             rec["round"] = round_idx
@@ -140,18 +162,35 @@ class MetricsLogger:
             self._wandb.log({k: v for k, v in rec.items() if not k.startswith("_")})
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         if self._fh:
             self._fh.close()
+            self._fh = None
         if self._wandb:
             self._wandb.finish()
+
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
 
 
 class RoundTimer:
     """Comm/compute tick-tock instrumentation (reference fedml_core/
     distributed/communication/utils.py:6-18 log_communication_tick/tock,
-    log_round_start/end) — wall-clock spans keyed by tag."""
+    log_round_start/end) — wall-clock spans keyed by tag.
 
-    def __init__(self):
+    Every ``tock`` also lands the span in the process tracer's stream
+    (obs/trace.py) when one is installed, so tick/tock call sites show up on
+    the same Perfetto timeline as the engine/prefetch/comm spans."""
+
+    def __init__(self, tracer=None):
+        # explicit tracer wins; default resolves the process tracer at tock
+        # time (so a timer built before trace.install() still exports)
+        self._tracer = tracer
         self._open: dict[str, float] = {}
         self.spans: list[tuple[str, float]] = []
 
@@ -159,8 +198,18 @@ class RoundTimer:
         self._open[tag] = time.perf_counter()
 
     def tock(self, tag: str) -> float:
-        dt = time.perf_counter() - self._open.pop(tag)
+        if tag not in self._open:
+            raise ValueError(
+                f"RoundTimer.tock({tag!r}) without a matching tick; "
+                f"currently open tags: {sorted(self._open) or 'none'}"
+            )
+        t0 = self._open.pop(tag)
+        t1 = time.perf_counter()
+        dt = t1 - t0
         self.spans.append((tag, dt))
+        tracer = self._tracer if self._tracer is not None else trace.get()
+        if tracer is not None:
+            tracer.add_span(tag, t0, t1)
         logging.debug("--- %s cost: %.4fs", tag, dt)
         return dt
 

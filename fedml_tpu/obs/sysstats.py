@@ -23,8 +23,19 @@ except Exception:  # pragma: no cover
 
 
 class SysStats:
+    """Samples host + device utilization.
+
+    ``psutil.cpu_percent(interval=None)`` measures utilization *since the
+    previous call* — its very first call has no reference window and always
+    returns 0.0. The constructor primes that counter, so the first
+    :meth:`sample` reports utilization since construction instead of a
+    constant 0.0 (each later sample covers the window since the one
+    before it)."""
+
     def __init__(self):
         self._t0 = time.time()
+        if HAS_PSUTIL:
+            psutil.cpu_percent(interval=None)  # prime the delta counter
 
     def sample(self) -> dict[str, Any]:
         out: dict[str, Any] = {"uptime_s": time.time() - self._t0}

@@ -1,0 +1,351 @@
+"""Process-wide span/event tracer: the one telemetry spine for the round
+driver, the prefetch pipeline, the experiment loops, the message-passing
+transport, and the compression subsystem (docs/OBSERVABILITY.md).
+
+The reference stack's observability is a pile of disconnected channels —
+per-process logging, wandb curves, MLOps MQTT telemetry, comm tick/tock
+wall-clock logs (fedml_core/distributed/communication/utils.py:6-18). None
+of them answer the questions the pipelined/packed engine raises: where does
+the host stall, how deep does the prefetch queue run, how full are the
+packed lanes, how long does a wire message spend in its handler. This
+module answers them with ONE stream of spans/counters that exports to JSONL
+and to Chrome trace-event JSON (loadable in Perfetto / ``chrome://tracing``,
+one track per thread).
+
+Design constraints:
+
+- **Read-only.** Tracing wraps host code with timers; it never touches rng,
+  staging, or aggregation. Traced runs are bit-identical to untraced runs
+  (tools/trace_smoke.py runs under the same engine the bit-identity smokes
+  guard).
+- **Zero overhead when disabled.** Hot-path call sites use the module-level
+  helpers (:func:`span` / :func:`gauge` / ...), which cost one global read
+  and return a shared no-op context manager when no tracer is installed.
+  Sites whose *attributes* cost anything (e.g. payload byte sums) guard on
+  :func:`get` first. bench.py's trace probe measures both sides.
+- **Thread-safe.** Spans land from the driver thread, the prefetch staging
+  thread, and every comm worker thread; each thread gets its own track id
+  (Chrome ``tid``) so Perfetto renders the pipeline overlap visually.
+
+Usage::
+
+    from fedml_tpu.obs import trace
+
+    with trace.span("engine/stage", round=r):
+        ...
+    trace.gauge("prefetch/queue_depth", q.qsize())
+
+    tracer = trace.install()          # start recording (process-wide)
+    ...
+    trace.uninstall()
+    tracer.export_chrome("trace.chrome.json")
+
+or, scoped (the ``--trace_dir`` entry-point wiring)::
+
+    with trace.trace_to(run_dir):     # exports trace.jsonl + chrome on exit
+        ...
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from pathlib import Path
+from typing import Any
+
+__all__ = [
+    "Tracer", "install", "uninstall", "get", "enabled",
+    "span", "event", "counter", "gauge", "trace_to",
+    "CHROME_TRACE_NAME", "JSONL_TRACE_NAME",
+]
+
+JSONL_TRACE_NAME = "trace.jsonl"
+CHROME_TRACE_NAME = "trace.chrome.json"
+
+
+class _NullSpan:
+    """Shared do-nothing context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span; created by :meth:`Tracer.span`."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self._name = name
+        self._attrs = attrs
+
+    def __enter__(self) -> "_Span":
+        self._t0 = self._tracer._clock()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self._tracer.add_span(self._name, self._t0, self._tracer._clock(),
+                              **self._attrs)
+        return False
+
+
+class Tracer:
+    """Thread-safe in-memory span/event recorder.
+
+    Events are stored directly in Chrome trace-event shape (``name``/``ph``/
+    ``ts``/``dur``/``tid``/``args``; timestamps in microseconds relative to
+    tracer construction, measured on ``time.perf_counter``), so both
+    exporters are a serialization of the same list. ``ph`` values used:
+    ``X`` complete span, ``C`` counter/gauge sample, ``i`` instant event.
+    """
+
+    PID = 1  # single-process tracer; one Chrome process track
+
+    # events kept in memory before recording stops (~150 bytes each →
+    # ~300 MB worst case). A week-long traced run must degrade to a
+    # truncated trace, not eat the host; exports report the drop count.
+    DEFAULT_MAX_EVENTS = 2_000_000
+
+    def __init__(self, max_events: int | None = None):
+        self._clock = time.perf_counter
+        self._t0 = self._clock()
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
+                            else int(max_events))
+        self.dropped = 0
+        self._thread_ids: dict[int, int] = {}
+        self._thread_names: dict[int, str] = {}
+
+    def _record(self, rec: dict) -> None:
+        with self._lock:
+            if len(self._events) >= self._max_events:
+                self.dropped += 1
+                return
+            self._events.append(rec)
+
+    # -- recording -----------------------------------------------------------
+
+    def _tid(self) -> int:
+        t = threading.current_thread()
+        ident = t.ident or 0
+        tid = self._thread_ids.get(ident)
+        if tid is None:
+            with self._lock:
+                tid = self._thread_ids.setdefault(
+                    ident, len(self._thread_ids) + 1
+                )
+                self._thread_names[tid] = t.name
+        return tid
+
+    def _us(self, t: float) -> float:
+        return (t - self._t0) * 1e6
+
+    def span(self, name: str, **attrs: Any) -> _Span:
+        """Context manager recording one complete span on the calling
+        thread's track; ``attrs`` become the span's Chrome ``args``."""
+        return _Span(self, name, attrs)
+
+    def add_span(self, name: str, t_start: float, t_end: float,
+                 **attrs: Any) -> None:
+        """Record an already-timed span (``time.perf_counter`` endpoints) —
+        the manual-timing API for callers like RoundTimer that measured the
+        interval themselves."""
+        rec = {
+            "name": name, "ph": "X", "ts": self._us(t_start),
+            "dur": max((t_end - t_start) * 1e6, 0.0), "tid": self._tid(),
+        }
+        if attrs:
+            rec["args"] = attrs
+        self._record(rec)
+
+    def event(self, name: str, **attrs: Any) -> None:
+        """Record an instant event (a point-in-time marker)."""
+        rec = {"name": name, "ph": "i", "ts": self._us(self._clock()),
+               "tid": self._tid(), "s": "t"}
+        if attrs:
+            rec["args"] = attrs
+        self._record(rec)
+
+    def counter(self, name: str, value: float, **attrs: Any) -> None:
+        """Record one sample of a named counter/gauge series."""
+        rec = {"name": name, "ph": "C", "ts": self._us(self._clock()),
+               "tid": self._tid(),
+               "args": {"value": float(value), **attrs}}
+        self._record(rec)
+
+    # a gauge is a counter whose samples are levels, not increments; the
+    # trace stream does not distinguish them
+    gauge = counter
+
+    # -- reading / export ----------------------------------------------------
+
+    def events(self) -> list[dict]:
+        """Snapshot of recorded events (copies the list, not the dicts)."""
+        with self._lock:
+            return list(self._events)
+
+    def thread_names(self) -> dict[int, str]:
+        with self._lock:
+            return dict(self._thread_names)
+
+    def export_jsonl(self, path: str | Path) -> Path:
+        """One event per line, same records as the Chrome export."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w") as f:
+            for rec in self.events():
+                f.write(json.dumps({"pid": self.PID, **rec}) + "\n")
+        return path
+
+    def export_chrome(self, path: str | Path) -> Path:
+        """Chrome trace-event JSON (object form with ``traceEvents``),
+        loadable in Perfetto / ``chrome://tracing``. Thread-name metadata
+        events give each Python thread its own named track."""
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": self.PID, "tid": 0,
+             "args": {"name": "fedml_tpu"}},
+        ]
+        for tid, tname in sorted(self.thread_names().items()):
+            meta.append({"name": "thread_name", "ph": "M", "pid": self.PID,
+                         "tid": tid, "args": {"name": tname}})
+        payload = {
+            "traceEvents": meta + [
+                {"pid": self.PID, **rec} for rec in self.events()
+            ],
+            "displayTimeUnit": "ms",
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+
+# ---------------------------------------------------------------------------
+# Process-wide tracer + the zero-overhead module-level helpers every
+# instrumented call site uses.
+# ---------------------------------------------------------------------------
+
+_tracer: Tracer | None = None
+
+
+def install(tracer: Tracer | None = None) -> Tracer:
+    """Install ``tracer`` (a fresh one by default) as the process tracer and
+    return it. Replaces any previously-installed tracer."""
+    global _tracer
+    _tracer = tracer if tracer is not None else Tracer()
+    return _tracer
+
+
+def uninstall() -> Tracer | None:
+    """Remove and return the process tracer (instrumentation reverts to the
+    no-op path)."""
+    global _tracer
+    t, _tracer = _tracer, None
+    return t
+
+
+def get() -> Tracer | None:
+    """The installed process tracer, or None. Call sites whose span
+    *attributes* are expensive to compute should guard on this."""
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def span(name: str, **attrs: Any):
+    """Span on the process tracer; shared no-op when none is installed."""
+    t = _tracer
+    return t.span(name, **attrs) if t is not None else _NULL_SPAN
+
+
+def event(name: str, **attrs: Any) -> None:
+    t = _tracer
+    if t is not None:
+        t.event(name, **attrs)
+
+
+def counter(name: str, value: float, **attrs: Any) -> None:
+    t = _tracer
+    if t is not None:
+        t.counter(name, value, **attrs)
+
+
+gauge = counter
+
+
+def run_traced(run_fn, args):
+    """Entry-point seam for the ``--trace_dir`` flag: run ``run_fn(args)``
+    under :class:`trace_to` when ``args.trace_dir`` is set, plain otherwise.
+    One definition shared by main_fedavg and every repro entry."""
+    trace_dir = getattr(args, "trace_dir", None)
+    if not trace_dir:
+        return run_fn(args)
+    with trace_to(trace_dir):
+        return run_fn(args)
+
+
+def add_cli_flag(parser):
+    """Register the canonical ``--trace_dir`` flag (one help text for every
+    entry point that supports traced runs)."""
+    parser.add_argument(
+        "--trace_dir", type=str, default=None,
+        help="record host-side span telemetry (round driver, prefetcher, "
+             "wire path — docs/OBSERVABILITY.md) and write trace.jsonl + "
+             "trace.chrome.json (Perfetto/chrome://tracing) into this dir; "
+             "read-only, results are unchanged",
+    )
+    return parser
+
+
+class trace_to:
+    """Context manager: install a fresh process tracer, and on exit export
+    ``trace.jsonl`` + ``trace.chrome.json`` into ``trace_dir`` and restore
+    the previously-installed tracer (if any). The ``--trace_dir`` wiring of
+    the experiment entry points."""
+
+    def __init__(self, trace_dir: str | Path):
+        self.trace_dir = Path(trace_dir)
+        self.tracer: Tracer | None = None
+        self._prev: Tracer | None = None
+
+    def __enter__(self) -> Tracer:
+        self._prev = get()
+        self.tracer = install()
+        return self.tracer
+
+    def __exit__(self, *exc) -> bool:
+        global _tracer
+        _tracer = self._prev
+        assert self.tracer is not None
+        self.jsonl_path = self.tracer.export_jsonl(
+            self.trace_dir / JSONL_TRACE_NAME
+        )
+        self.chrome_path = self.tracer.export_chrome(
+            self.trace_dir / CHROME_TRACE_NAME
+        )
+        import logging
+
+        logging.info("trace written: %s (%d events); open %s in Perfetto",
+                     self.jsonl_path, len(self.tracer.events()),
+                     self.chrome_path)
+        if self.tracer.dropped:
+            logging.warning(
+                "trace truncated: %d events dropped past the %d-event cap "
+                "(Tracer(max_events=...) raises it)",
+                self.tracer.dropped, self.tracer._max_events,
+            )
+        return False

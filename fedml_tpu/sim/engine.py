@@ -27,6 +27,7 @@ from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
+from fedml_tpu.obs import trace
 from fedml_tpu.parallel import compat
 from fedml_tpu.parallel import mesh as meshlib
 from fedml_tpu.sim import cohort as cohortlib
@@ -297,6 +298,10 @@ class FedSim:
         # multi-controller (jax.distributed) jobs: every process stages the
         # same host arrays but materializes only its addressable shards
         self._multihost = jax.process_count() > 1
+        # per-program-kind first-dispatch tracking: the first dispatch of a
+        # compiled program includes its XLA compilation, so marking it in
+        # the trace stream is the compile event (obs/trace.py)
+        self._dispatched: set[str] = set()
 
         # The round program is shard_mapped manually over the ``clients`` axis:
         # each device runs an ordinary vmap over its local cohort slice, then
@@ -822,6 +827,11 @@ class FedSim:
         cohort builder) shipped with block sharding, plus per-round rng
         keys. Pure in (config, rounds, root_rng), so the prefetch thread
         can build the next block while the current one executes."""
+        with trace.span("engine/stage", round=start_round,
+                        n_rounds=n_rounds, block=True):
+            return self._stage_block_impl(start_round, n_rounds, root_rng)
+
+    def _stage_block_impl(self, start_round: int, n_rounds: int, root_rng):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         per_round = [
@@ -856,10 +866,13 @@ class FedSim:
             staged if staged is not None
             else self._stage_block(start_round, n_rounds, root_rng)
         )
-        return self._get_block_fn(n_rounds)(
-            global_variables, server_state, self._dataset, idxs, weights,
-            num_steps, rngs,
-        )
+        with trace.span("engine/dispatch", program=f"block{n_rounds}",
+                        round=start_round, n_rounds=n_rounds,
+                        first=self._first_dispatch(f"block{n_rounds}")):
+            return self._get_block_fn(n_rounds)(
+                global_variables, server_state, self._dataset, idxs, weights,
+                num_steps, rngs,
+            )
 
     def _eval_impl(self, variables, batches):
         def step(carry, batch):
@@ -1038,13 +1051,14 @@ class FedSim:
         on-device index map or the host batch stack, + weights, budgets,
         and the round's rng key; a :class:`PackedStaged` lane plan when
         packed execution is on)."""
-        if self._pack:
-            return self._stage_packed_round(cohort, round_idx, rkey)
-        if self._on_device:
-            staged = self.stage_cohort_indices(cohort, round_idx)
-        else:
-            staged = self.stage_cohort(cohort, round_idx)
-        return staged + (rkey,)
+        with trace.span("engine/stage", round=round_idx, packed=self._pack):
+            if self._pack:
+                return self._stage_packed_round(cohort, round_idx, rkey)
+            if self._on_device:
+                staged = self.stage_cohort_indices(cohort, round_idx)
+            else:
+                staged = self.stage_cohort(cohort, round_idx)
+            return staged + (rkey,)
 
     def _pack_round_plan(self, cohort, round_idx: int):
         """Host-only planning for one packed round: the round's [C_pad, S, B]
@@ -1089,6 +1103,14 @@ class FedSim:
         (config, round_idx, rkey) like every staging path, so the prefetch
         thread can run it ahead."""
         idx, weights, num_steps, plan = self._pack_round_plan(cohort, round_idx)
+        # lane occupancy (executed steps / scanned lane slots, overflow
+        # passes included) and overflow-pass count per round: the two
+        # numbers that say whether the lane geometry fits the population
+        trace.gauge("engine/lane_occupancy",
+                    plan.total_steps / max(plan.capacity, 1),
+                    round=round_idx)
+        trace.counter("engine/overflow_passes", len(plan.passes) - 1,
+                      round=round_idx)
         lane_shard = meshlib.client_sharded(self.mesh)
         passes = []
         for pp in plan.passes:
@@ -1119,19 +1141,36 @@ class FedSim:
             },
         )
 
+    def _first_dispatch(self, program: str) -> bool:
+        """True exactly once per compiled-program kind, emitting the trace
+        compile marker: a program's first dispatch blocks on its XLA
+        compilation, so the span it labels IS the compile event."""
+        if program in self._dispatched:
+            return False
+        self._dispatched.add(program)
+        trace.event("engine/first_dispatch", program=program)
+        return True
+
     def run_staged_round(self, staged, global_variables, server_state):
         """Dispatch one round from a stage_round payload."""
         if isinstance(staged, PackedStaged):
-            return self._run_packed(staged, global_variables, server_state)
+            with trace.span("engine/dispatch", program="packed",
+                            n_passes=staged.stats["n_passes"],
+                            first=self._first_dispatch("packed")):
+                return self._run_packed(staged, global_variables, server_state)
         data, weights, num_steps, rkey = staged
         if self._on_device:
-            return self._gather_round_fn(
-                global_variables, server_state, self._dataset, data, weights,
-                num_steps, rkey,
+            with trace.span("engine/dispatch", program="gather",
+                            first=self._first_dispatch("gather")):
+                return self._gather_round_fn(
+                    global_variables, server_state, self._dataset, data,
+                    weights, num_steps, rkey,
+                )
+        with trace.span("engine/dispatch", program="padded",
+                        first=self._first_dispatch("padded")):
+            return self._round_fn(
+                global_variables, server_state, data, weights, num_steps, rkey
             )
-        return self._round_fn(
-            global_variables, server_state, data, weights, num_steps, rkey
-        )
 
     def _run_packed(self, staged: PackedStaged, global_variables, server_state):
         """One packed round: zero buffers, P lane-scan passes chaining the
@@ -1250,11 +1289,13 @@ class FedSim:
     def eval_record(self, variables) -> dict[str, float]:
         """The test-round metric block: pooled eval (+ per-client summary
         when configured). One definition for every run loop."""
-        eval_vars = self.consensus(variables)
-        out = self.evaluate(eval_vars)
-        if self.config.eval_on_clients:
-            out.update(self.per_client_summary(eval_vars))
-        return out
+        with trace.span("engine/eval",
+                        on_clients=self.config.eval_on_clients):
+            eval_vars = self.consensus(variables)
+            out = self.evaluate(eval_vars)
+            if self.config.eval_on_clients:
+                out.update(self.per_client_summary(eval_vars))
+            return out
 
     def evaluate(self, variables) -> dict[str, float]:
         if not self._can_eval:
@@ -1405,10 +1446,12 @@ class FedSim:
                 if is_eval_round(last) or depth == 0:
                     # synchronization point: fetch everything queued
                     # (including this segment's metrics), then eval
-                    ready = pending + drain.push(segment, stacked) + drain.flush()
-                    pending = []
-                    if depth == 0:
-                        jax.block_until_ready(variables)
+                    with trace.span("engine/sync", round=last):
+                        ready = (pending + drain.push(segment, stacked)
+                                 + drain.flush())
+                        pending = []
+                        if depth == 0:
+                            jax.block_until_ready(variables)
                     per_round = (
                         (time.perf_counter() - t_mark)
                         / max(rounds_in_window, 1)

@@ -30,9 +30,12 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterable
 
 import jax
+
+from fedml_tpu.obs import trace
 
 THREAD_NAME = "fedsim-prefetch"
 
@@ -67,7 +70,8 @@ class Prefetcher:
             for task in self._tasks:
                 if self._stop.is_set():
                     return
-                payload = self._stage(task)
+                with trace.span("prefetch/stage", task=str(task)):
+                    payload = self._stage(task)
                 if not self._offer((task, payload)):
                     return
         except BaseException as e:  # noqa: BLE001 — must reach the consumer
@@ -76,44 +80,66 @@ class Prefetcher:
 
     def _offer(self, item) -> bool:
         """Bounded put that never wedges: gives up when close() fires."""
-        while not self._stop.is_set():
-            try:
-                self._q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        try:
+            # fast path: room in the queue, the producer is ahead of the
+            # consumer (the healthy pipelined state)
+            self._q.put_nowait(item)
+        except queue.Full:
+            # the producer is blocked on a full queue — the device side is
+            # the bottleneck. A span per blocked wait makes that visible.
+            with trace.span("prefetch/producer_blocked"):
+                while True:
+                    if self._stop.is_set():
+                        return False
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+        trace.gauge("prefetch/queue_depth", self._q.qsize())
+        return True
 
     def get(self, task: Any) -> Any:
         """Return the staged payload for ``task`` — which must be the next
         task in submission order (the driver consumes the same plan it
         handed the prefetcher)."""
+        try:
+            # fast path: the payload is already staged (pipeline keeping up)
+            staged_task, payload = self._q.get_nowait()
+        except queue.Empty:
+            # the consumer is stalled waiting on staging — host staging is
+            # the bottleneck for this round
+            with trace.span("prefetch/consumer_stall", task=str(task)):
+                staged_task, payload = self._wait_for_item(task)
+        trace.gauge("prefetch/queue_depth", self._q.qsize())
+        if staged_task is _SENTINEL:
+            raise self._exc
+        if staged_task != task:
+            raise RuntimeError(
+                f"prefetch order violated: staged {staged_task!r}, "
+                f"requested {task!r}"
+            )
+        return payload
+
+    def _wait_for_item(self, task: Any) -> tuple:
+        """Blocking wait for the next staged item, robust to a worker that
+        died (re-raises its exception) or exited short."""
         while True:
             try:
-                staged_task, payload = self._q.get(timeout=0.2)
+                return self._q.get(timeout=0.2)
             except queue.Empty:
                 if not self._thread.is_alive():
                     # the worker may have enqueued its final payload and
                     # exited between our timeout and this check — drain
                     # before concluding it died short
                     try:
-                        staged_task, payload = self._q.get_nowait()
+                        return self._q.get_nowait()
                     except queue.Empty:
                         if self._exc is not None:
                             raise self._exc
                         raise RuntimeError(
                             f"prefetch worker exited before staging {task!r}"
                         ) from None
-                else:
-                    continue
-            if staged_task is _SENTINEL:
-                raise self._exc
-            if staged_task != task:
-                raise RuntimeError(
-                    f"prefetch order violated: staged {staged_task!r}, "
-                    f"requested {task!r}"
-                )
-            return payload
 
     def close(self) -> None:
         """Stop the worker and join it. Safe to call repeatedly, safe to
@@ -158,10 +184,10 @@ class MetricsDrain:
 
     def __init__(self, depth: int = 1):
         self.depth = max(0, int(depth))
-        self._q: list[tuple[Any, Any]] = []
+        self._q: list[tuple[Any, Any, float]] = []
 
     def push(self, tag: Any, metrics: Any) -> list[tuple[Any, Any]]:
-        self._q.append((tag, metrics))
+        self._q.append((tag, metrics, time.perf_counter()))
         out = []
         while len(self._q) > self.depth:
             out.append(self._fetch(self._q.pop(0)))
@@ -173,6 +199,10 @@ class MetricsDrain:
         return out
 
     @staticmethod
-    def _fetch(item: tuple[Any, Any]) -> tuple[Any, Any]:
-        tag, metrics = item
-        return tag, jax.device_get(metrics)
+    def _fetch(item: tuple[Any, Any, float]) -> tuple[Any, Any]:
+        tag, metrics, pushed = item
+        # behind_s = how long these metrics sat on device before the driver
+        # fetched them — the pipeline's fetch-behind latency per round
+        with trace.span("prefetch/drain_fetch", tag=str(tag),
+                        behind_s=round(time.perf_counter() - pushed, 6)):
+            return tag, jax.device_get(metrics)

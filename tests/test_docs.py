@@ -13,6 +13,7 @@ DOCS = [
     DOCS_DIR / "MIGRATION.md",
     DOCS_DIR / "COMPRESSION.md",
     DOCS_DIR / "PERFORMANCE.md",
+    DOCS_DIR / "OBSERVABILITY.md",
 ]
 
 

@@ -1,0 +1,519 @@
+"""Span tracer, instrumented-layer emission, and the obs satellite fixes
+(RoundTimer / MetricsLogger / CommBytesAccountant / SysStats)."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.obs import trace
+from fedml_tpu.obs.metrics import (
+    COMM_DOWNLINK_RATIO,
+    COMM_RATIO,
+    CommBytesAccountant,
+    MetricsLogger,
+    RoundTimer,
+)
+from fedml_tpu.obs.trace import Tracer
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """Every test starts and ends with no process tracer installed."""
+    trace.uninstall()
+    yield
+    trace.uninstall()
+
+
+# -- Tracer core -------------------------------------------------------------
+
+
+def test_span_nesting_across_threads():
+    t = Tracer()
+
+    def work(tag):
+        with t.span("outer", tag=tag):
+            with t.span("inner", tag=tag):
+                time.sleep(0.002)
+
+    threads = [threading.Thread(target=work, args=(i,), name=f"w{i}")
+               for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    work("main")
+
+    spans = [e for e in t.events() if e["ph"] == "X"]
+    assert len(spans) == 8  # 4 threads x (outer + inner)
+    # one track id per thread, and thread names recorded for the export
+    tids = {e["tid"] for e in spans}
+    assert len(tids) == 4
+    names = t.thread_names()
+    assert {"w0", "w1", "w2"} <= set(names.values())
+    # per thread: inner nests inside outer (child exits first, so it is
+    # appended first; timestamps contain it)
+    by_tid = {}
+    for e in spans:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for group in by_tid.values():
+        inner = next(e for e in group if e["name"] == "inner")
+        outer = next(e for e in group if e["name"] == "outer")
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+        assert inner["args"]["tag"] == outer["args"]["tag"]
+
+
+def test_disabled_tracer_is_shared_noop():
+    assert trace.get() is None and not trace.enabled()
+    s1 = trace.span("anything", round=3)
+    s2 = trace.span("else")
+    assert s1 is s2  # the shared no-op instance: nothing allocated per call
+    with s1:
+        pass
+    trace.event("x")
+    trace.counter("c", 1.0)
+    trace.gauge("g", 2.0)  # none of these may raise or record anywhere
+
+    tracer = trace.install()
+    with trace.span("real"):
+        pass
+    assert [e["name"] for e in tracer.events()] == ["real"]
+    trace.uninstall()
+    assert trace.span("again") is s1
+
+
+def test_event_cap_truncates_not_grows():
+    t = Tracer(max_events=3)
+    for i in range(5):
+        t.event(f"e{i}")
+    assert len(t.events()) == 3
+    assert t.dropped == 2
+    assert [e["name"] for e in t.events()] == ["e0", "e1", "e2"]
+
+
+def test_install_returns_and_replaces():
+    a = trace.install()
+    assert trace.get() is a
+    b = trace.install()
+    assert trace.get() is b and a is not b
+    assert trace.uninstall() is b
+    assert trace.get() is None
+
+
+def test_chrome_export_schema(tmp_path):
+    t = Tracer()
+    with t.span("s", k=1):
+        t.event("marker", note="hi")
+        t.counter("depth", 2)
+    path = t.export_chrome(tmp_path / "t.json")
+    raw = json.loads(path.read_text())
+    events = raw["traceEvents"]
+    named_tids = {e["tid"] for e in events
+                  if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert named_tids, "thread_name metadata missing"
+    body = [e for e in events if e.get("ph") != "M"]
+    assert {e["ph"] for e in body} == {"X", "i", "C"}
+    for e in body:
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["tid"], int) and e["tid"] in named_tids
+        assert e["pid"] == Tracer.PID
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    counter = next(e for e in body if e["ph"] == "C")
+    assert counter["args"]["value"] == 2.0
+
+
+def test_jsonl_export_and_report_loader(tmp_path):
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report",
+        Path(__file__).parent.parent / "tools" / "trace_report.py",
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    t = Tracer()
+    with t.span("a"):
+        with t.span("b"):
+            pass
+    jl = t.export_jsonl(tmp_path / "t.jsonl")
+    ch = t.export_chrome(tmp_path / "t.chrome.json")
+    for path in (jl, ch):
+        events = trace_report.load_events(path)
+        assert {e["name"] for e in events} == {"a", "b"}
+
+    report = trace_report.summarize(trace_report.load_events(ch))
+    rows = {r["name"]: r for r in report["spans"]}
+    # self time: a's self excludes b (same-thread nesting by timestamps)
+    assert rows["a"]["self_ms"] <= rows["a"]["total_ms"]
+    assert rows["b"]["total_ms"] <= rows["a"]["total_ms"]
+
+
+def test_report_self_time_and_stall_fraction():
+    import importlib.util
+    from pathlib import Path
+
+    spec = importlib.util.spec_from_file_location(
+        "trace_report2",
+        Path(__file__).parent.parent / "tools" / "trace_report.py",
+    )
+    trace_report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trace_report)
+
+    events = [
+        {"name": "loop/round", "ph": "X", "ts": 0.0, "dur": 100.0, "tid": 1},
+        {"name": "prefetch/consumer_stall", "ph": "X", "ts": 10.0,
+         "dur": 40.0, "tid": 1},
+        {"name": "engine/dispatch", "ph": "X", "ts": 60.0, "dur": 30.0,
+         "tid": 1},
+        {"name": "engine/lane_occupancy", "ph": "C", "ts": 5.0, "tid": 1,
+         "args": {"value": 0.75}},
+    ]
+    rep = trace_report.summarize(events)
+    rows = {r["name"]: r for r in rep["spans"]}
+    assert rows["loop/round"]["total_ms"] == 0.1
+    # 100 - (40 + 30) = 30 us self
+    assert rows["loop/round"]["self_ms"] == pytest.approx(0.03)
+    assert rep["stall_fraction"] == pytest.approx(0.4)
+    assert rep["lane_occupancy_mean"] == 0.75
+
+
+def test_trace_to_exports_and_restores(tmp_path):
+    outer = trace.install()
+    with trace.trace_to(tmp_path):
+        assert trace.get() is not outer
+        with trace.span("inside"):
+            pass
+    assert trace.get() is outer  # previous tracer restored
+    assert (tmp_path / trace.JSONL_TRACE_NAME).exists()
+    chrome = json.loads((tmp_path / trace.CHROME_TRACE_NAME).read_text())
+    assert any(e.get("name") == "inside" for e in chrome["traceEvents"])
+
+
+# -- instrumented layers -----------------------------------------------------
+
+
+def test_prefetcher_stall_gauge_and_span_emission():
+    from fedml_tpu.sim.prefetch import Prefetcher
+
+    tracer = trace.install()
+    try:
+        # slow staging, eager consumer -> consumer stalls
+        with Prefetcher(range(3), lambda r: (time.sleep(0.03), r)[1],
+                        depth=1) as pf:
+            for r in range(3):
+                assert pf.get(r) == r
+        names = [e["name"] for e in tracer.events()]
+        assert "prefetch/consumer_stall" in names
+        assert "prefetch/stage" in names
+        depths = [e for e in tracer.events()
+                  if e["ph"] == "C" and e["name"] == "prefetch/queue_depth"]
+        assert depths and all("value" in e["args"] for e in depths)
+
+        # instant staging, slow consumer, depth 1 -> producer blocks
+        with Prefetcher(range(4), lambda r: r, depth=1) as pf:
+            time.sleep(0.25)  # let the producer fill the queue and block
+            for r in range(4):
+                assert pf.get(r) == r
+        names = [e["name"] for e in tracer.events()]
+        assert "prefetch/producer_blocked" in names
+    finally:
+        trace.uninstall()
+
+
+def test_metrics_drain_fetch_behind_span():
+    from fedml_tpu.sim.prefetch import MetricsDrain
+
+    tracer = trace.install()
+    try:
+        d = MetricsDrain(depth=1)
+        assert d.push(0, {"m": np.float32(1)}) == []
+        out = d.push(1, {"m": np.float32(2)})
+        assert [tag for tag, _ in out] == [0]
+        out = d.flush()
+        assert [tag for tag, _ in out] == [1]
+        fetches = [e for e in tracer.events()
+                   if e["name"] == "prefetch/drain_fetch"]
+        assert len(fetches) == 2
+        assert all(e["args"]["behind_s"] >= 0 for e in fetches)
+    finally:
+        trace.uninstall()
+
+
+def test_wire_path_span_attrs_on_loopback():
+    """comm/send + comm/recv + comm/handler spans carry message type and
+    payload bytes on the loopback backend."""
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.comm.managers import DistributedManager
+    from fedml_tpu.comm.message import Message
+
+    MSG = 7
+    payload = np.arange(12, dtype=np.float32)  # 48 bytes
+
+    class Echo(DistributedManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(MSG, self._on)
+
+        def _on(self, msg):
+            np.testing.assert_array_equal(
+                np.asarray(msg.get("blob")), payload
+            )
+            self.finish()
+
+    fabric = LoopbackFabric(2)
+    receiver = Echo(LoopbackCommManager(fabric, 1), rank=1, size=2)
+    sender = DistributedManager(LoopbackCommManager(fabric, 0), rank=0, size=2)
+
+    tracer = trace.install()
+    try:
+        th = threading.Thread(target=receiver.run, daemon=True)
+        th.start()
+        msg = Message(MSG, 0, 1)
+        msg.add_params("blob", payload)
+        sender.send_message(msg)
+        th.join(timeout=10.0)
+        assert not th.is_alive()
+    finally:
+        trace.uninstall()
+
+    spans = {e["name"]: e for e in tracer.events() if e["ph"] == "X"}
+    assert {"comm/send", "comm/recv", "comm/handler"} <= set(spans)
+    for name in ("comm/send", "comm/recv"):
+        assert spans[name]["args"]["msg_type"] == MSG
+        assert spans[name]["args"]["bytes"] == payload.nbytes
+    assert spans["comm/handler"]["args"]["msg_type"] == MSG
+    # send lands on the caller thread, recv/handler on the receive loop's
+    assert spans["comm/send"]["tid"] != spans["comm/handler"]["tid"]
+
+
+def test_message_payload_nbytes():
+    from fedml_tpu.comm.message import Message
+
+    msg = Message(1, 0, 1)
+    msg.add_params("a", np.zeros(10, np.float32))
+    msg.add_params("b", np.zeros((2, 3), np.int64))
+    msg.add_params("note", "not an array")
+    assert msg.payload_nbytes() == 40 + 48
+
+
+def test_compress_accumulate_span():
+    from fedml_tpu.compress import make_codec
+    from fedml_tpu.compress.aggregate import accumulate_encoded
+
+    import jax
+
+    codec = make_codec("q8")
+    tree = {"w": np.linspace(-1, 1, 16).astype(np.float32)}
+    enc = jax.tree.map(np.asarray, codec.encode(tree, jax.random.key(0)))
+    tracer = trace.install()
+    try:
+        acc = np.zeros(16, np.float64)
+        accumulate_encoded(acc, enc, 1.0, codec)
+    finally:
+        trace.uninstall()
+    names = [e["name"] for e in tracer.events()]
+    assert "compress/accumulate" in names
+    assert "compress/decode" in names  # q8 takes the dense-decode path
+
+
+def test_engine_round_spans_and_first_dispatch_marker():
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(
+        n_clients=4, samples_per_client=16, num_classes=3, seed=1
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=3),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(client_num_in_total=4, client_num_per_round=4,
+                    batch_size=8, comm_round=2, frequency_of_the_test=2,
+                    seed=0)
+    sim = FedSim(trainer, train, test, cfg)
+    tracer = trace.install()
+    try:
+        sim.run()
+    finally:
+        trace.uninstall()
+    events = tracer.events()
+    names = [e["name"] for e in events]
+    for expected in ("engine/stage", "engine/dispatch", "engine/sync",
+                     "engine/eval"):
+        assert expected in names, names
+    firsts = [e for e in events if e["name"] == "engine/first_dispatch"]
+    assert len(firsts) == 1  # one program kind, marked exactly once
+    dispatches = [e for e in events if e["name"] == "engine/dispatch"]
+    assert [d["args"]["first"] for d in dispatches].count(True) == 1
+
+
+def test_traced_run_bit_identical_to_untraced():
+    """Tracing is read-only: same records, same final variables."""
+    import jax
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(
+        n_clients=4, samples_per_client=16, num_classes=3, seed=2
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=3),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(client_num_in_total=4, client_num_per_round=2,
+                    batch_size=8, comm_round=3, frequency_of_the_test=2,
+                    seed=0)
+
+    v_plain, h_plain = FedSim(trainer, train, test, cfg).run()
+    trace.install()
+    try:
+        v_traced, h_traced = FedSim(trainer, train, test, cfg).run()
+    finally:
+        trace.uninstall()
+    for a, b in zip(jax.tree.leaves(v_plain), jax.tree.leaves(v_traced)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for rp, rt in zip(h_plain, h_traced):
+        for k, v in rp.items():
+            if k != "round_time":
+                assert rt[k] == v, k
+
+
+def test_cli_trace_dir_writes_trace(tmp_path):
+    """--trace_dir on the unified entry records and exports the run."""
+    import argparse
+
+    from fedml_tpu.exp.main_fedavg import add_args, run
+
+    parser = add_args(argparse.ArgumentParser())
+    args = parser.parse_args([
+        "--model", "lr", "--dataset", "synthetic_0.5_0.5",
+        "--client_num_in_total", "8", "--client_num_per_round", "4",
+        "--batch_size", "8", "--comm_round", "2",
+        "--frequency_of_the_test", "2", "--lr", "0.05",
+        "--trace_dir", str(tmp_path),
+    ])
+    history = run(args)
+    assert len(history) == 2
+    assert trace.get() is None  # tracer uninstalled after the run
+    jsonl = tmp_path / trace.JSONL_TRACE_NAME
+    chrome = tmp_path / trace.CHROME_TRACE_NAME
+    assert jsonl.exists() and chrome.exists()
+    names = {json.loads(line)["name"] for line in jsonl.read_text().splitlines()}
+    assert any(n.startswith("engine/") for n in names)
+    assert any(n.startswith("prefetch/") for n in names)
+
+
+def test_trace_smoke_tool_runs():
+    """tools/trace_smoke.py is the end-to-end guard the docs point at — run
+    it in-process so tier-1 exercises the five-layer trace stream."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "trace_smoke.py"
+    spec = importlib.util.spec_from_file_location("trace_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
+
+
+# -- satellite fixes ---------------------------------------------------------
+
+
+def test_round_timer_unmatched_tock_raises_clearly():
+    t = RoundTimer()
+    t.tick("comm")
+    t.tick("agg")
+    with pytest.raises(ValueError, match=r"tock\('nope'\).*'agg'.*'comm'"):
+        t.tock("nope")
+    assert t.tock("comm") >= 0.0  # open tags survive the failed tock
+    with pytest.raises(ValueError, match="none"):
+        RoundTimer().tock("x")
+
+
+def test_round_timer_delegates_spans_to_tracer():
+    tracer = Tracer()
+    t = RoundTimer(tracer=tracer)
+    t.tick("round")
+    time.sleep(0.002)
+    dt = t.tock("round")
+    spans = tracer.events()
+    assert [e["name"] for e in spans] == ["round"]
+    assert spans[0]["dur"] == pytest.approx(dt * 1e6, rel=0.05)
+
+    # default: the process tracer picked up at tock time
+    proc = trace.install()
+    try:
+        t2 = RoundTimer()
+        t2.tick("x")
+        t2.tock("x")
+    finally:
+        trace.uninstall()
+    assert [e["name"] for e in proc.events()] == ["x"]
+    # and without any tracer, tick/tock still works (summary only)
+    t3 = RoundTimer()
+    t3.tick("y")
+    t3.tock("y")
+    assert "y" in t3.summary()
+
+
+def test_metrics_logger_context_manager_and_close_semantics(tmp_path):
+    with pytest.raises(RuntimeError, match="boom"):
+        with MetricsLogger(run_dir=tmp_path) as m:
+            m.log({"Train/Acc": 0.5}, round_idx=0)
+            raise RuntimeError("boom")
+    # the handle was closed by __exit__ despite the exception
+    assert m._fh is None
+    lines = (tmp_path / "metrics.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 1
+
+    m.close()  # idempotent: second close is a no-op
+    m.close()
+    with pytest.raises(RuntimeError, match="after close"):
+        m.log({"Train/Acc": 0.6}, round_idx=1)
+
+
+def test_accountant_downlink_compression_ratio():
+    acc = CommBytesAccountant()
+    acc.record_uplink(100, 400)
+    acc.record_downlink(200, 600)
+    rec = acc.round_record(0)
+    assert rec[COMM_RATIO] == pytest.approx(4.0)
+    assert rec[COMM_DOWNLINK_RATIO] == pytest.approx(3.0)
+    acc.record_downlink(100, 100)  # post-flush traffic (stop broadcast)
+    totals = acc.totals()
+    assert totals[COMM_DOWNLINK_RATIO] == pytest.approx(700 / 300)
+    assert totals[COMM_RATIO] == pytest.approx(4.0)
+    # ratio keys are derived — byte totals must not absorb them
+    assert totals["Comm/DownlinkBytes"] == 300
+
+    # guard: no downlink traffic -> no downlink ratio key
+    empty = CommBytesAccountant()
+    empty.record_uplink(10, 20)
+    assert COMM_DOWNLINK_RATIO not in empty.round_record(0)
+    assert COMM_DOWNLINK_RATIO not in empty.totals()
+
+
+def test_sysstats_cpu_counter_primed():
+    from fedml_tpu.obs import sysstats
+
+    s = sysstats.SysStats()
+    sample = s.sample()
+    assert "uptime_s" in sample
+    if sysstats.HAS_PSUTIL:
+        # the constructor primed cpu_percent, so the first sample reports a
+        # real utilization measurement (a float; 0.0 only if the host was
+        # truly idle over the window, not the unprimed constant)
+        assert isinstance(sample["cpu_utilization"], float)

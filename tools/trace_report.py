@@ -1,0 +1,189 @@
+"""Summarize a fedml_tpu trace (obs/trace.py output, JSONL or Chrome
+trace-event JSON): top spans by total/self time, pipeline stall fraction,
+packed-lane occupancy, and counter series — the terminal-side answer to
+"where did the round time go" before (or instead of) opening Perfetto.
+
+    python tools/trace_report.py RUN_DIR/trace.chrome.json
+    python tools/trace_report.py RUN_DIR/trace.jsonl --format json --top 15
+
+See docs/OBSERVABILITY.md for what each span family means.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# span names whose total duration is host-side *waiting* rather than work —
+# their share of wall time is the pipeline stall fraction
+STALL_SPANS = ("prefetch/producer_blocked", "prefetch/consumer_stall")
+OCCUPANCY_GAUGE = "engine/lane_occupancy"
+
+
+def load_events(path: str | Path) -> list[dict]:
+    """Load trace events from either exporter format. Chrome files are an
+    object with a ``traceEvents`` list; JSONL files are one event per line.
+    Metadata (``ph == "M"``) events are dropped."""
+    path = Path(path)
+    text = path.read_text()
+    try:  # Chrome form: ONE json document (multi-line JSONL fails this)
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        events = [json.loads(line) for line in text.splitlines() if line.strip()]
+    else:
+        if isinstance(obj, dict) and "traceEvents" in obj:
+            events = obj["traceEvents"]
+        elif isinstance(obj, list):
+            events = obj
+        else:  # a one-line JSONL file parses as a single event dict
+            events = [obj]
+    out = []
+    for e in events:
+        if e.get("ph") == "M":
+            continue
+        if "name" not in e or "ts" not in e or "ph" not in e:
+            raise ValueError(
+                f"{path}: event missing name/ts/ph fields: {e!r}"
+            )
+        out.append(e)
+    return out
+
+
+def _self_times(spans: list[dict]) -> dict[int, float]:
+    """Per-span self time (dur minus same-thread children), computed from
+    timestamp nesting: spans recorded by context managers on one thread are
+    properly nested, so a stack sweep in ts order recovers the tree.
+    Returns {id(span): self_us}."""
+    out: dict[int, float] = {}
+    by_tid: dict[int, list[dict]] = {}
+    for s in spans:
+        by_tid.setdefault(s.get("tid", 0), []).append(s)
+    for group in by_tid.values():
+        group.sort(key=lambda s: (s["ts"], -s.get("dur", 0.0)))
+        stack: list[tuple[float, dict, list[float]]] = []  # (end, span, child durs)
+
+        def pop(entry):
+            end, span, children = entry
+            out[id(span)] = max(span.get("dur", 0.0) - sum(children), 0.0)
+
+        for s in group:
+            dur = s.get("dur", 0.0)
+            while stack and stack[-1][0] <= s["ts"] + 1e-9:
+                pop(stack.pop())
+            # count s toward the enclosing span's children only when fully
+            # contained: manually-timed spans (Tracer.add_span, e.g.
+            # RoundTimer tags) can overlap without nesting, and subtracting
+            # a merely-overlapping span would corrupt the parent's self time
+            if stack and stack[-1][0] >= s["ts"] + dur - 1e-9:
+                stack[-1][2].append(dur)
+            stack.append((s["ts"] + dur, s, []))
+        while stack:
+            pop(stack.pop())
+    return out
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate a trace into the report dict: per-name span rollups
+    (count/total/self/max, sorted by total desc), wall span, stall
+    fraction, lane occupancy, and counter last-values."""
+    spans = [e for e in events if e.get("ph") == "X"]
+    counters = [e for e in events if e.get("ph") == "C"]
+    instants = [e for e in events if e.get("ph") == "i"]
+    if not events:
+        return {"wall_ms": 0.0, "spans": [], "counters": {},
+                "stall_fraction": None, "lane_occupancy_mean": None,
+                "events": 0}
+    t_min = min(e["ts"] for e in events)
+    t_max = max(e["ts"] + e.get("dur", 0.0) for e in events)
+    wall_us = max(t_max - t_min, 1e-9)
+
+    selfs = _self_times(spans)
+    rollup: dict[str, dict] = {}
+    for s in spans:
+        r = rollup.setdefault(
+            s["name"],
+            {"name": s["name"], "count": 0, "total_ms": 0.0,
+             "self_ms": 0.0, "max_ms": 0.0},
+        )
+        dur_ms = s.get("dur", 0.0) / 1e3
+        r["count"] += 1
+        r["total_ms"] += dur_ms
+        r["self_ms"] += selfs.get(id(s), 0.0) / 1e3
+        r["max_ms"] = max(r["max_ms"], dur_ms)
+    span_rows = sorted(rollup.values(), key=lambda r: -r["total_ms"])
+    for r in span_rows:
+        for k in ("total_ms", "self_ms", "max_ms"):
+            r[k] = round(r[k], 3)
+
+    stall_us = sum(
+        s.get("dur", 0.0) for s in spans if s["name"] in STALL_SPANS
+    )
+    counter_rollup: dict[str, dict] = {}
+    for c in counters:
+        v = c.get("args", {}).get("value")
+        r = counter_rollup.setdefault(
+            c["name"], {"count": 0, "last": None, "mean": 0.0})
+        r["count"] += 1
+        r["last"] = v
+        if v is not None:
+            r["mean"] += (v - r["mean"]) / r["count"]
+    for r in counter_rollup.values():
+        r["mean"] = round(r["mean"], 4)
+    occ = counter_rollup.get(OCCUPANCY_GAUGE)
+    return {
+        "wall_ms": round(wall_us / 1e3, 3),
+        "spans": span_rows,
+        "counters": counter_rollup,
+        "instants": sorted({e["name"] for e in instants}),
+        "stall_fraction": round(stall_us / wall_us, 4),
+        "lane_occupancy_mean": occ["mean"] if occ else None,
+        "events": len(events),
+    }
+
+
+def format_text(report: dict, top: int) -> str:
+    lines = [
+        f"wall {report['wall_ms']:.1f} ms, {report['events']} events, "
+        f"stall fraction {report['stall_fraction']}"
+        + (f", lane occupancy {report['lane_occupancy_mean']}"
+           if report["lane_occupancy_mean"] is not None else ""),
+        "",
+        f"{'span':<34} {'count':>6} {'total ms':>10} {'self ms':>10} {'max ms':>9}",
+    ]
+    for r in report["spans"][:top]:
+        lines.append(
+            f"{r['name']:<34} {r['count']:>6} {r['total_ms']:>10.2f} "
+            f"{r['self_ms']:>10.2f} {r['max_ms']:>9.2f}"
+        )
+    if report["counters"]:
+        lines += ["", f"{'counter':<34} {'samples':>7} {'mean':>10} {'last':>10}"]
+        for name in sorted(report["counters"]):
+            c = report["counters"][name]
+            lines.append(
+                f"{name:<34} {c['count']:>7} {c['mean']:>10} {c['last']:>10}"
+            )
+    if report["instants"]:
+        lines += ["", "markers: " + ", ".join(report["instants"])]
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fedml_tpu trace summarizer")
+    p.add_argument("trace", help="trace.jsonl or trace.chrome.json "
+                                 "(obs/trace.py exports)")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--top", type=int, default=20,
+                   help="span rows to print (text format)")
+    args = p.parse_args(argv)
+    report = summarize(load_events(args.trace))
+    if args.format == "json":
+        print(json.dumps(report))
+    else:
+        print(format_text(report, args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
