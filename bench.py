@@ -447,6 +447,75 @@ def bench_pack_ab(n_rounds: int = 3):
     }
 
 
+BROADCAST_WORKERS = 8  # the broadcast A/B probe's fan-out width
+BROADCAST_PAYLOAD_MB = 4.0
+
+
+def bench_broadcast_ab(n_fanouts: int = 25):
+    """Encode-once broadcast vs per-rank fan-out (docs/PERFORMANCE.md "The
+    server wire path") at N=8 loopback receivers with a model-sized payload:
+    arm A frames the message ONCE per fan-out (`broadcast_message`; shared
+    payload buffer, per-receiver header patch), arm B replays the legacy
+    per-rank `send_message` loop (one full serialization per receiver).
+    Payload serializations are counted through the wire ledger
+    (fedml_tpu.comm.message.wire_stats); queues are drained between fan-outs
+    so memory, not backpressure, stays constant. Returns probe metrics."""
+    import numpy as np
+
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.comm.message import Message, reset_wire_stats, wire_stats
+
+    N = BROADCAST_WORKERS
+    payload = np.random.RandomState(0).rand(
+        int(BROADCAST_PAYLOAD_MB * (1 << 20) // 4)
+    ).astype(np.float32)
+    fabric = LoopbackFabric(N + 1)
+    mgr = LoopbackCommManager(fabric, 0)
+    receivers = list(range(1, N + 1))
+    per_recv = {r: {"client_idx": r} for r in receivers}
+
+    def drain():
+        for r in receivers:
+            q = fabric.queues[r]
+            while not q.empty():
+                q.get_nowait()
+
+    def fanout_broadcast():
+        msg = Message(2, 0, 1)
+        msg.add_params("model_params", payload)
+        mgr.broadcast_message(msg, receivers, per_receiver=per_recv)
+
+    def fanout_per_rank():
+        for r in receivers:
+            msg = Message(2, 0, r)
+            msg.add_params("model_params", payload)
+            msg.add_params("client_idx", r)
+            mgr.send_message(msg)
+
+    out = {}
+    for label, fanout in (("broadcast", fanout_broadcast),
+                          ("per_rank", fanout_per_rank)):
+        fanout(); drain()  # warm
+        reset_wire_stats()
+        t0 = time.perf_counter()
+        for _ in range(n_fanouts):
+            fanout()
+            drain()
+        dt = time.perf_counter() - t0
+        out[f"{label}_fanouts_per_sec"] = round(n_fanouts / dt, 2)
+        out[f"{label}_serializations_per_fanout"] = (
+            wire_stats()["payload_serializations"] / n_fanouts
+        )
+    out.update({
+        "broadcast_receivers": N,
+        "broadcast_payload_mb": BROADCAST_PAYLOAD_MB,
+        "broadcast_speedup": round(
+            out["broadcast_fanouts_per_sec"] / out["per_rank_fanouts_per_sec"], 2
+        ),
+    })
+    return out
+
+
 def bench_resnet(reduced: bool = False):
     """(rounds/sec, eval examples/sec, pipeline extras) for the primary
     ResNet-56 config.
@@ -811,6 +880,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_trace_overhead())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["trace_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_broadcast_probe"
+    try:
+        pipeline_extra.update(bench_broadcast_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["broadcast_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_stage_probe"
     try:

@@ -46,24 +46,57 @@ class MyMessage:
     MSG_ARG_KEY_ROUND_IDX = "round_idx"
 
 
+class EmptyRoundError(RuntimeError):
+    """``aggregate()`` was asked to close a round with ZERO uploads —
+    every worker (stragglers included) was dropped by the elastic round
+    timeout. The server keeps the previous global model in that case
+    (``_round_timed_out`` re-arms instead of closing); calling aggregate
+    directly on an empty tally is a protocol bug, reported loudly instead
+    of the legacy ``IndexError``/NaN."""
+
+
 class FedAvgDistAggregator:
-    """Server-side round state (FedAVGAggregator.py:13-108): collect models,
-    weighted-average when all arrived."""
+    """Server-side round tally, streaming (accumulate-on-arrival).
+
+    The reference (FedAVGAggregator.py:13-108) buffers every worker's model
+    until round end and sums on one thread — O(workers x model) peak host
+    memory, with all the summation work serialized at round close. Here each
+    upload is folded into ONE f64 accumulator as it lands
+    (``acc += n_i * x_i``, ``wsum += n_i``) and ``aggregate()`` divides at
+    round close: peak memory is O(model) and the adds amortize over the
+    receive timeline. Elastic-timeout renormalization is unchanged — the
+    divisor is the weight sum over whoever actually uploaded, so dropped
+    stragglers renormalize away.
+
+    Folds happen in arrival order (f64 addition is not associative, so two
+    runs with different arrival orders can differ in the accumulator's last
+    ULPs — the standard streaming-aggregation tradeoff).
+    :class:`BufferedFedAvgDistAggregator` keeps the legacy retain-then-sum
+    shape but replays the SAME fold arithmetic in the same arrival order, so
+    streaming == buffered bit-for-bit under any schedule
+    (tools/wire_smoke.py + tests/test_wire_path.py hold the contract)."""
 
     def __init__(self, worker_num: int):
         self.worker_num = worker_num
-        self.model_dict: dict[int, np.ndarray] = {}
         self.sample_num_dict: dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
         self._lock = threading.Lock()  # reference hazard fixed (SURVEY §5.2)
+        self._acc: np.ndarray | None = None
+        self._wsum = 0.0
 
     def exclude_worker(self, index: int) -> None:
         """Permanently stop expecting this worker (marked OFFLINE): later
         rounds complete on the live set alone instead of re-waiting for the
-        timeout every round."""
+        timeout every round. Only workers that have NOT uploaded this round
+        can be excluded — a streaming tally cannot retract a folded
+        contribution (the timeout path only ever excludes missing workers)."""
         with self._lock:
+            if self.flag_client_model_uploaded_dict.get(index):
+                raise ValueError(
+                    f"worker {index} already uploaded this round; a streaming "
+                    "tally cannot retract a folded contribution"
+                )
             self.flag_client_model_uploaded_dict.pop(index, None)
-            self.model_dict.pop(index, None)
             self.sample_num_dict.pop(index, None)
 
     def live_workers(self) -> list[int]:
@@ -74,36 +107,104 @@ class FedAvgDistAggregator:
         with self._lock:
             return index in self.flag_client_model_uploaded_dict
 
+    def _fold(self, payload, sample_num: float) -> None:
+        """Fold one upload into the running tally (caller holds the lock).
+        Payloads are pack_pytree byte vectors; model leaves are float32
+        (validated against the descriptor at server init), so the weighted
+        accumulation runs on an f32 view."""
+        x = np.ascontiguousarray(payload).view(np.float32)
+        if self._acc is None:
+            self._acc = np.zeros(x.size, np.float64)
+        self._acc += np.multiply(x, float(sample_num), dtype=np.float64)
+        self._wsum += float(sample_num)
+
+    def _finish(self) -> np.ndarray:
+        """Close the tally (caller holds the lock): divide by the weight sum
+        and return wire bytes."""
+        out = (self._acc / self._wsum).astype(np.float32).view(np.uint8)
+        self._acc = None
+        self._wsum = 0.0
+        return out
+
     def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
         with self._lock:
-            if index not in self.flag_client_model_uploaded_dict:
+            flags = self.flag_client_model_uploaded_dict
+            if index not in flags:
                 return False  # excluded (OFFLINE) worker resurfaced; ignore
-            self.model_dict[index] = flat_params
+            if flags[index]:
+                # duplicate upload within one round: first wins (a streaming
+                # tally cannot replace a folded contribution; the protocol's
+                # round-idx guard keeps this unreachable in practice)
+                return all(flags.values())
+            self._fold(flat_params, sample_num)
             self.sample_num_dict[index] = sample_num
-            self.flag_client_model_uploaded_dict[index] = True
-            return all(self.flag_client_model_uploaded_dict.values())
+            flags[index] = True
+            return all(flags.values())
 
     def received_workers(self) -> list[int]:
         with self._lock:
             return [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
 
     def aggregate(self) -> np.ndarray:
-        # Payloads are pack_pytree byte vectors; model leaves are float32
-        # (validated against the descriptor at server init), so the weighted
-        # average runs on an f32 view and returns bytes for the wire.
-        # Aggregates whichever workers uploaded this round (all of them in
+        # Closes over whichever workers uploaded this round (all of them in
         # the synchronous case; the survivors when the elastic round timeout
         # dropped stragglers) with weights renormalized over that subset.
         with self._lock:
-            got = [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
-            w = np.asarray([self.sample_num_dict[i] for i in got], np.float64)
-            w = w / w.sum()
-            out = np.zeros(self.model_dict[got[0]].size // 4, dtype=np.float64)
-            for wi, i in zip(w, got):
-                out += wi * np.ascontiguousarray(self.model_dict[i]).view(np.float32)
-            for i in self.flag_client_model_uploaded_dict:
-                self.flag_client_model_uploaded_dict[i] = False
-            return out.astype(np.float32).view(np.uint8)
+            flags = self.flag_client_model_uploaded_dict
+            if not any(flags.values()):
+                raise EmptyRoundError(
+                    "no worker uploads this round (all "
+                    f"{len(flags)} live workers dropped by the round "
+                    "timeout); keeping the previous global model — nothing "
+                    "to aggregate"
+                )
+            out = self._finish()
+            for i in flags:
+                flags[i] = False
+            return out
+
+
+class BufferedFedAvgDistAggregator(FedAvgDistAggregator):
+    """Legacy-shaped tally (the reference's FedAVGAggregator memory
+    profile): retains every worker's payload and folds them at round close —
+    in arrival order, through the SAME ``_fold``/``_finish`` arithmetic as
+    the streaming base, so the two are bit-identical under any schedule.
+    Kept as the A/B reference for the streaming path (``buffered_
+    aggregation=True`` on the server manager; tools/wire_smoke.py)."""
+
+    def __init__(self, worker_num: int):
+        super().__init__(worker_num)
+        self.model_dict: dict[int, np.ndarray] = {}  # insertion == arrival
+
+    def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
+        with self._lock:
+            flags = self.flag_client_model_uploaded_dict
+            if index not in flags:
+                return False
+            if flags[index]:
+                return all(flags.values())
+            self.model_dict[index] = flat_params
+            self.sample_num_dict[index] = sample_num
+            flags[index] = True
+            return all(flags.values())
+
+    def aggregate(self) -> np.ndarray:
+        with self._lock:
+            flags = self.flag_client_model_uploaded_dict
+            if not self.model_dict:
+                raise EmptyRoundError(
+                    "no worker uploads this round (all "
+                    f"{len(flags)} live workers dropped by the round "
+                    "timeout); keeping the previous global model — nothing "
+                    "to aggregate"
+                )
+            for i, payload in self.model_dict.items():
+                self._fold(payload, self.sample_num_dict[i])
+            self.model_dict.clear()
+            out = self._finish()
+            for i in flags:
+                flags[i] = False
+            return out
 
 
 class FedAvgServerManager(ServerManager):
@@ -114,12 +215,23 @@ class FedAvgServerManager(ServerManager):
                  client_num_in_total: int | None = None,
                  round_timeout: float | None = None,
                  exclude_after: int = 2,
-                 on_round_done: Callable[[int, np.ndarray], None] | None = None):
+                 on_round_done: Callable[[int, np.ndarray], None] | None = None,
+                 use_broadcast: bool = True,
+                 buffered_aggregation: bool = False):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
         self.round_num = round_num
         self.round_idx = 0
-        self.aggregator = FedAvgDistAggregator(worker_num)
+        # wire-path knobs (docs/PERFORMANCE.md "The server wire path"):
+        # use_broadcast=False reverts downlink to the legacy per-rank send
+        # loop; buffered_aggregation=True reverts the tally to the legacy
+        # retain-then-sum shape — both kept as the A/B reference arms
+        self.use_broadcast = bool(use_broadcast)
+        self.buffered_aggregation = bool(buffered_aggregation)
+        self.aggregator = (
+            BufferedFedAvgDistAggregator if self.buffered_aggregation
+            else FedAvgDistAggregator
+        )(worker_num)
         self.global_flat = init_flat
         self.model_desc = model_desc
         # elastic rounds (SURVEY §5.4 failure handling): if set, a round
@@ -158,15 +270,60 @@ class FedAvgServerManager(ServerManager):
         """Inverse seam: a client upload back to the flat byte vector."""
         return np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
 
+    def _fanout_model(self, msg_type: int, ranks: list[int], cohort=None,
+                      include_desc: bool = False, finished: bool = False) -> None:
+        """Downlink fan-out through the encode-once broadcast path: ranks
+        whose ``_model_payload`` is the same object share ONE wire frame
+        (one payload serialization for the whole group — the mobile server's
+        per-rank JSON payloads fall back to singleton groups); per-rank
+        scalars (the assigned client index) ride per-receiver header
+        overrides. ``use_broadcast=False`` replays the legacy per-rank
+        ``send_message`` loop for A/B comparison."""
+        if not ranks:
+            return
+        payloads = {w: self._model_payload(w) for w in ranks}
+        groups: dict[int, list[int]] = {}
+        for w in ranks:
+            groups.setdefault(id(payloads[w]), []).append(w)
+        for group in groups.values():
+            per_receiver = None
+            if cohort is not None:
+                per_receiver = {
+                    w: {MyMessage.MSG_ARG_KEY_CLIENT_INDEX: int(cohort[w - 1])}
+                    for w in group
+                }
+            if self.use_broadcast:
+                msg = Message(msg_type, 0, group[0])
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                               payloads[group[0]])
+                if include_desc:
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
+                                   self.model_desc)
+                if finished:
+                    msg.add_params("finished", 1)
+                self.broadcast_message(msg, group, per_receiver=per_receiver)
+            else:
+                for w in group:
+                    msg = Message(msg_type, 0, w)
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                   payloads[w])
+                    if include_desc:
+                        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
+                                       self.model_desc)
+                    if finished:
+                        msg.add_params("finished", 1)
+                    if per_receiver is not None:
+                        for k, v in per_receiver[w].items():
+                            msg.add_params(k, v)
+                    self.send_message(msg)
+
     def send_init_msg(self) -> None:
         cohort = rnglib.sample_clients(0, self.client_num_in_total, self.worker_num)
-        for w in range(self.worker_num):
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, 0, w + 1)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                           self._model_payload(w + 1))
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC, self.model_desc)
-            msg.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
-            self.send_message(msg)
+        self._fanout_model(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
+            [w + 1 for w in range(self.worker_num)],
+            cohort=cohort, include_desc=True,
+        )
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -246,14 +403,10 @@ class FedAvgServerManager(ServerManager):
             [w + 1 for w in missing],
             f", excluding {excluded} as OFFLINE" if excluded else "",
         )
-        for w in excluded:
-            # tell the excluded client to stop: it would otherwise keep
-            # training models the server discards every round
-            stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w)
-            stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                            self._model_payload(w))
-            stop.add_params("finished", 1)
-            self.send_message(stop)
+        # tell the excluded clients to stop: they would otherwise keep
+        # training models the server discards every round
+        self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                           excluded, finished=True)
         self._complete_round(expected_round)
 
     def _complete_round(self, expected_round: int) -> None:
@@ -271,21 +424,15 @@ class FedAvgServerManager(ServerManager):
             self.on_round_done(expected_round, self.global_flat)
         if self.round_idx >= self.round_num:
             # graceful stop: notify clients then stop own loop (NOT MPI.Abort)
-            for w in range(self.worker_num):
-                stop = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
-                stop.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                                self._model_payload(w + 1))
-                stop.add_params("finished", 1)
-                self.send_message(stop)
+            self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                               [w + 1 for w in range(self.worker_num)],
+                               finished=True)
             self.finish()
             return
         cohort = rnglib.sample_clients(self.round_idx, self.client_num_in_total, self.worker_num)
-        for w in self.aggregator.live_workers():
-            sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, w + 1)
-            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                            self._model_payload(w + 1))
-            sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(cohort[w]))
-            self.send_message(sync)
+        self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                           [w + 1 for w in self.aggregator.live_workers()],
+                           cohort=cohort)
 
 
 class FedAvgClientManager(ClientManager):
@@ -362,35 +509,49 @@ class FedAvgClientManager(ClientManager):
 
 
 class CompressedDistAggregator(FedAvgDistAggregator):
-    """Server tally for encoded uploads: stores each client's EncodedUpdate
-    (sparse planes — the whole point: the transport and the tally hold
-    kilobytes, not dense models) and aggregates by streaming every upload
-    into ONE dense f64 accumulator (top-k scatter-adds straight from its
-    index/value planes). Delta-domain codecs add the result onto the current
-    global; the ``none`` codec carries models and reproduces the dense
-    protocol's arithmetic bit-for-bit."""
+    """Streaming tally for encoded uploads: each client's EncodedUpdate is
+    folded into ONE dense f64 accumulator AS IT ARRIVES (top-k scatter-adds
+    straight from its index/value planes — the server never materializes
+    per-client dense trees, and with streaming it no longer retains the
+    encoded uploads either). ``aggregate()`` divides by the weight sum at
+    round close; delta-domain codecs add the result onto the current global;
+    the ``none`` codec carries models and reproduces the dense protocol's
+    arithmetic bit-for-bit."""
 
     def __init__(self, worker_num: int, codec):
         super().__init__(worker_num)
         self.codec = codec
         self.get_global = None  # wired by the server manager (current flat)
 
-    def aggregate(self) -> np.ndarray:
+    def _fold(self, payload, sample_num: float) -> None:
         from fedml_tpu.compress.aggregate import accumulate_encoded
 
-        with self._lock:
-            got = [i for i, f in self.flag_client_model_uploaded_dict.items() if f]
-            w = np.asarray([self.sample_num_dict[i] for i in got], np.float64)
-            w = w / w.sum()
+        if self._acc is None:
             base = np.ascontiguousarray(self.get_global()).view(np.float32)
-            acc = np.zeros(base.size, np.float64)
-            for wi, i in zip(w, got):
-                accumulate_encoded(acc, self.model_dict[i], wi, self.codec)
-            if self.codec.delta_domain:
-                acc += base.astype(np.float64)
-            for i in self.flag_client_model_uploaded_dict:
-                self.flag_client_model_uploaded_dict[i] = False
-            return acc.astype(np.float32).view(np.uint8)
+            self._acc = np.zeros(base.size, np.float64)
+        accumulate_encoded(self._acc, payload, float(sample_num), self.codec)
+        self._wsum += float(sample_num)
+
+    def _finish(self) -> np.ndarray:
+        acc = self._acc / self._wsum
+        if self.codec.delta_domain:
+            base = np.ascontiguousarray(self.get_global()).view(np.float32)
+            acc += base.astype(np.float64)
+        self._acc = None
+        self._wsum = 0.0
+        return acc.astype(np.float32).view(np.uint8)
+
+
+class CompressedBufferedDistAggregator(BufferedFedAvgDistAggregator,
+                                       CompressedDistAggregator):
+    """Legacy-shaped compressed tally: retains the encoded uploads and folds
+    them at round close in arrival order, through the same fold arithmetic —
+    the A/B reference for :class:`CompressedDistAggregator` (bit-identical
+    under any schedule)."""
+
+    def __init__(self, worker_num: int, codec):
+        CompressedDistAggregator.__init__(self, worker_num, codec)
+        self.model_dict = {}
 
 
 class CompressedFedAvgServerManager(FedAvgServerManager):
@@ -402,7 +563,10 @@ class CompressedFedAvgServerManager(FedAvgServerManager):
         if codec is None:
             raise ValueError("CompressedFedAvgServerManager needs a codec")
         self.codec = codec
-        self.aggregator = CompressedDistAggregator(self.worker_num, codec)
+        self.aggregator = (
+            CompressedBufferedDistAggregator if self.buffered_aggregation
+            else CompressedDistAggregator
+        )(self.worker_num, codec)
         self.aggregator.get_global = lambda: self.global_flat
         from fedml_tpu.obs.metrics import CommBytesAccountant
 
@@ -666,19 +830,27 @@ def run_distributed_fedavg_grpc(
     batch_size: int,
     seed: int = 0,
     base_port: int = 29500,
+    send_timeout: float = 600.0,
+    send_workers: int = 4,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
     **runner_kwargs,
 ):
     """Distributed FedAvg over localhost gRPC (cross-host transport run
     single-host; an ip_config table generalizes it to a cluster, reference
-    grpc_ipconfig.csv)."""
+    grpc_ipconfig.csv). ``send_timeout``/``send_workers`` plumb the run
+    config into every rank's transport (per-send unary deadline and
+    broadcast send-pool width)."""
     from fedml_tpu.comm.grpc_backend import GRPCCommManager
 
     ip_config = {
         r: ("127.0.0.1", base_port + r) for r in range(worker_num + 1)
     }
-    mgrs = {r: GRPCCommManager(r, ip_config) for r in range(worker_num + 1)}
+    mgrs = {
+        r: GRPCCommManager(r, ip_config, send_timeout=send_timeout,
+                           send_workers=send_workers)
+        for r in range(worker_num + 1)
+    }
     try:
         return run_distributed_fedavg(
             trainer, train_data, worker_num, round_num, batch_size,

@@ -6,17 +6,26 @@ handle_receive_message / stop_receive_message) and observer.py:4
 (``Observer.receive_message(msg_type, msg_params)``). Contract preserved;
 backends here are push-driven (no 0.3 s polling loop — the reference defect
 listed in SURVEY §7 'what NOT to port').
+
+On top of the reference surface the contract grows the high-throughput
+downlink primitive (docs/PERFORMANCE.md "The server wire path"):
+``broadcast_message`` frames a message ONCE (one payload serialization for
+the whole fan-out) and emits one wire copy per receiver through the
+``_send_framed`` backend hook, optionally overlapping the per-receiver sends
+on a bounded :class:`~fedml_tpu.comm.send_pool.SendWorkerPool`.
 """
 
 from __future__ import annotations
 
 import abc
+from functools import partial
 from typing import TYPE_CHECKING
 
 from fedml_tpu.obs import trace
 
 if TYPE_CHECKING:
-    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.message import FramedMessage, Message
+    from fedml_tpu.comm.send_pool import SendWorkerPool
 
 
 class Observer(abc.ABC):
@@ -25,8 +34,9 @@ class Observer(abc.ABC):
 
 
 class BaseCommunicationManager(abc.ABC):
-    def __init__(self):
+    def __init__(self, send_pool: "SendWorkerPool | None" = None):
         self._observers: list[Observer] = []
+        self._send_pool = send_pool
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -49,6 +59,52 @@ class BaseCommunicationManager(abc.ABC):
 
     @abc.abstractmethod
     def send_message(self, msg: "Message") -> None: ...
+
+    def broadcast_message(self, msg: "Message",
+                          receiver_ids: list[int],
+                          per_receiver: dict[int, dict] | None = None) -> None:
+        """Encode-once fan-out: frame ``msg`` once and send one wire copy to
+        every receiver (the per-receiver header is patched, the payload
+        segments are shared). ``per_receiver`` carries small header-only
+        param overrides keyed by receiver (e.g. each worker's assigned
+        client index); array overrides are rejected by the frame.
+
+        With a send pool installed the per-receiver sends run concurrently
+        and this call returns after all of them completed (first error
+        re-raised) — downlink wall time is the slowest leg, not the sum.
+        """
+        frame = msg.frame()
+        frame.tail_bytes()  # join the shared payload ONCE, before pooled
+        # legs race the lazy cache and each redo the O(payload) join
+        msg_type, sender = msg.get_type(), msg.get_sender_id()
+        nbytes = frame.payload_nbytes
+
+        def send_one(dst: int) -> None:
+            ov = per_receiver.get(dst) if per_receiver else None
+            with trace.span("comm/send", msg_type=msg_type, sender=sender,
+                            receiver=dst, bytes=nbytes, broadcast=1):
+                self._send_framed(frame, dst, ov)
+
+        pool = self._send_pool
+        if pool is None:
+            for dst in receiver_ids:
+                send_one(dst)
+        else:
+            pool.run_all([(dst, partial(send_one, dst)) for dst in receiver_ids])
+
+    def _send_framed(self, frame: "FramedMessage", dst: int,
+                     overrides: dict | None = None) -> None:
+        """Backend hook for one leg of a broadcast. The in-repo byte
+        transports override this with a ``frame.bytes_for(dst)`` send (no
+        payload re-serialization); this default keeps third-party backends
+        correct by rebuilding a Message that shares the frame's payload
+        buffers (their own ``send_message`` may still re-encode)."""
+        self.send_message(frame.to_message(dst, overrides))
+
+    def _close_send_pool(self) -> None:
+        """Backends call this from ``stop_receive_message``."""
+        if self._send_pool is not None:
+            self._send_pool.close()
 
     @abc.abstractmethod
     def handle_receive_message(self) -> None:

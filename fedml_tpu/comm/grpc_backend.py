@@ -13,11 +13,13 @@ from __future__ import annotations
 import csv
 import logging
 import threading
+from collections import deque
 from concurrent import futures
 from pathlib import Path
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.send_pool import SendWorkerPool
 
 try:
     import grpc
@@ -47,16 +49,26 @@ def read_ip_config(path: str | Path) -> dict[int, tuple[str, int]]:
 
 
 class GRPCCommManager(BaseCommunicationManager):
-    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]]):
+    def __init__(self, rank: int, ip_config: dict[int, tuple[str, int]],
+                 send_timeout: float = 600.0, send_workers: int = 4):
+        """``send_timeout`` (seconds, per unary send) and ``send_workers``
+        (broadcast send-pool width; 0 = serial fan-out on the caller thread)
+        are plumbed from the run config (``--grpc_send_timeout`` /
+        ``--grpc_send_workers`` on main_fedavg, or ``create_backend`` kw)."""
         if not HAS_GRPC:
             raise RuntimeError("grpcio not available")
-        super().__init__()
+        super().__init__(send_pool=(
+            SendWorkerPool(send_workers, name=f"grpc-send-r{rank}")
+            if send_workers else None
+        ))
         self.rank = rank
         self.ip_config = ip_config
-        self._queue: list[bytes] = []
+        self.send_timeout = float(send_timeout)
+        self._queue: deque[bytes] = deque()
         self._cv = threading.Condition()
         self._channels: dict[int, grpc.Channel] = {}
         self._stubs: dict[int, object] = {}
+        self._stub_lock = threading.Lock()
         self._running = False
 
         host, port = ip_config[rank]
@@ -91,21 +103,26 @@ class GRPCCommManager(BaseCommunicationManager):
         logging.info("grpc server rank %d listening on %d", rank, port)
 
     def _stub(self, dst: int):
-        if dst not in self._stubs:
-            host, port = self.ip_config[dst]
-            opts = [
-                ("grpc.max_send_message_length", _MAX_LEN),
-                ("grpc.max_receive_message_length", _MAX_LEN),
-            ]
-            ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
-            self._channels[dst] = ch
-            self._stubs[dst] = ch.unary_unary(
-                _METHOD, request_serializer=_IDENT, response_deserializer=_IDENT
-            )
-        return self._stubs[dst]
+        # pooled broadcast legs may create stubs concurrently
+        with self._stub_lock:
+            if dst not in self._stubs:
+                host, port = self.ip_config[dst]
+                opts = [
+                    ("grpc.max_send_message_length", _MAX_LEN),
+                    ("grpc.max_receive_message_length", _MAX_LEN),
+                ]
+                ch = grpc.insecure_channel(f"{host}:{port}", options=opts)
+                self._channels[dst] = ch
+                self._stubs[dst] = ch.unary_unary(
+                    _METHOD, request_serializer=_IDENT, response_deserializer=_IDENT
+                )
+            return self._stubs[dst]
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.to_bytes(), timeout=600)
+        self._stub(msg.get_receiver_id())(msg.to_bytes(), timeout=self.send_timeout)
+
+    def _send_framed(self, frame, dst: int, overrides: dict | None = None) -> None:
+        self._stub(dst)(frame.bytes_for(dst, overrides), timeout=self.send_timeout)
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -115,13 +132,14 @@ class GRPCCommManager(BaseCommunicationManager):
                     self._cv.wait(timeout=0.2)
                 if not self._running:
                     break
-                data = self._queue.pop(0)
+                data = self._queue.popleft()
             self.notify(Message.from_bytes(data))
 
     def stop_receive_message(self) -> None:
         self._running = False
         with self._cv:
             self._cv.notify_all()
+        self._close_send_pool()
         for ch in self._channels.values():
             ch.close()
         self._server.stop(grace=0.5)

@@ -6,16 +6,20 @@ in-process loopback comm backend"). This backend gives every rank a queue in
 one process; ranks run in threads. It is the unit-test transport for the
 manager/algorithm protocol layers and the semantic model for the shm/grpc
 backends.
+
+Broadcast fan-outs post two-part ``(head, shared_tail)`` frames: every
+receiver of one broadcast decodes zero-copy views into ONE shared payload
+buffer (read-only — Message.from_buffers enforces it), so an N-worker model
+broadcast materializes the payload bytes once, not N times.
 """
 
 from __future__ import annotations
 
 import queue
-import threading
-from typing import Any
 
 from fedml_tpu.comm.base import BaseCommunicationManager
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import FramedMessage, Message
+from fedml_tpu.comm.send_pool import SendWorkerPool
 
 
 class LoopbackFabric:
@@ -27,20 +31,34 @@ class LoopbackFabric:
 
     def post(self, msg: Message) -> None:
         # serialize/deserialize through the real wire format so tests cover it
-        self.queues[msg.get_receiver_id()].put(msg.to_bytes())
+        self.post_raw(msg.get_receiver_id(), msg.to_bytes())
+
+    def post_raw(self, receiver: int, data) -> None:
+        """Queue already-framed wire data: ``bytes`` or a broadcast's
+        ``(head, shared_tail)`` pair."""
+        self.queues[receiver].put(data)
 
 
 class LoopbackCommManager(BaseCommunicationManager):
     _STOP = object()
 
-    def __init__(self, fabric: LoopbackFabric, rank: int):
-        super().__init__()
+    def __init__(self, fabric: LoopbackFabric, rank: int, send_workers: int = 0):
+        super().__init__(send_pool=(
+            SendWorkerPool(send_workers, name=f"loopback-send-r{rank}")
+            if send_workers else None
+        ))
         self.fabric = fabric
         self.rank = rank
         self._running = False
 
     def send_message(self, msg: Message) -> None:
         self.fabric.post(msg)
+
+    def _send_framed(self, frame: FramedMessage, dst: int,
+                     overrides: dict | None = None) -> None:
+        # two-part post: per-receiver head, ONE shared payload buffer
+        self.fabric.post_raw(dst, (frame.head_for(dst, overrides),
+                                   frame.tail_bytes()))
 
     def handle_receive_message(self) -> None:
         self._running = True
@@ -49,8 +67,12 @@ class LoopbackCommManager(BaseCommunicationManager):
             item = q.get()
             if item is self._STOP:
                 break
-            self.notify(Message.from_bytes(item))
+            if isinstance(item, tuple):
+                self.notify(Message.from_buffers(*item))
+            else:
+                self.notify(Message.from_bytes(item))
 
     def stop_receive_message(self) -> None:
         self._running = False
+        self._close_send_pool()
         self.fabric.queues[self.rank].put(self._STOP)

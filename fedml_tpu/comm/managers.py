@@ -33,7 +33,11 @@ def create_backend(backend: str, rank: int, world_size: int, **kw) -> BaseCommun
         from fedml_tpu.comm.grpc_backend import GRPCCommManager, read_ip_config
 
         ip_config = kw.get("ip_config") or read_ip_config(kw["ip_config_path"])
-        mgr = GRPCCommManager(rank, ip_config)
+        mgr = GRPCCommManager(
+            rank, ip_config,
+            send_timeout=kw.get("grpc_send_timeout", 600.0),
+            send_workers=kw.get("grpc_send_workers", 4),
+        )
     elif backend == "mqtt":
         from fedml_tpu.comm.mqtt_backend import MqttCommManager
 
@@ -92,6 +96,27 @@ class DistributedManager(Observer):
                          receiver=msg.get_receiver_id(),
                          bytes=msg.payload_nbytes()):
             self.comm.send_message(msg)
+
+    def broadcast_message(self, msg: Message, receiver_ids: list[int],
+                          per_receiver: dict[int, dict] | None = None) -> None:
+        """Encode-once downlink fan-out (docs/PERFORMANCE.md "The server
+        wire path"): the payload is framed once and every receiver gets a
+        header-patched wire copy; ``per_receiver`` carries small header-only
+        overrides (e.g. assigned client index). Per-leg ``comm/send`` spans
+        are emitted by the backend (on pool worker threads when a send pool
+        overlaps the legs); this wrapper adds the enclosing
+        ``comm/broadcast`` span on the manager thread."""
+        receiver_ids = list(receiver_ids)
+        if not receiver_ids:
+            return
+        tracer = trace.get()
+        if tracer is None:
+            self.comm.broadcast_message(msg, receiver_ids, per_receiver)
+            return
+        with tracer.span("comm/broadcast", msg_type=msg.get_type(),
+                         sender=self.rank, receivers=len(receiver_ids),
+                         bytes=msg.payload_nbytes()):
+            self.comm.broadcast_message(msg, receiver_ids, per_receiver)
 
     def register_message_receive_handlers(self) -> None:
         raise NotImplementedError

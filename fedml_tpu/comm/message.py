@@ -9,18 +9,55 @@ a raw little-endian array segment per tensor — never pickled objects. Model
 payloads are (flat byte vector, leaf-descriptor) pairs produced by
 ``pack_pytree`` — leaves keep their native dtypes bit-exactly; the descriptor
 records path/shape/dtype per leaf.
+
+Framing is zero-copy on both sides (docs/PERFORMANCE.md "The server wire
+path"): packing an already-contiguous array contributes a ``memoryview`` of
+its buffer (no model bytes copied until a byte-oriented transport joins the
+frame), and unpacking produces alignment-safe ``np.frombuffer`` views into
+the received buffer, marked read-only so two receivers of one shared
+broadcast buffer can never alias-write each other's model. The encode-once
+broadcast primitive is :class:`FramedMessage`: one payload serialization per
+fan-out, with the per-receiver header patched in place.
 """
 
 from __future__ import annotations
 
-import io
 import json
 import struct
+import threading
 from typing import Any
 
 import numpy as np
 
 import jax
+
+
+# --- wire-level stats --------------------------------------------------------
+# Counts payload serializations (frames built with at least one array
+# segment) so the encode-once contract is testable: a broadcast to N workers
+# increments this ONCE; the legacy per-rank loop increments it N times.
+# bench.py's broadcast A/B probe and tools/wire_smoke.py read these.
+
+_WIRE_LOCK = threading.Lock()
+_WIRE_STATS = {"payload_serializations": 0, "frames": 0}
+
+
+def wire_stats() -> dict[str, int]:
+    """Snapshot of the process-wide wire counters."""
+    with _WIRE_LOCK:
+        return dict(_WIRE_STATS)
+
+
+def reset_wire_stats() -> None:
+    with _WIRE_LOCK:
+        for k in _WIRE_STATS:
+            _WIRE_STATS[k] = 0
+
+
+def _byte_view(a: np.ndarray) -> np.ndarray:
+    """Flat uint8 view of a contiguous array — zero-copy reinterpretation
+    (``ascontiguousarray`` is a no-op on already-contiguous input)."""
+    return np.ascontiguousarray(a).reshape(-1).view(np.uint8)
 
 
 class Message:
@@ -77,41 +114,55 @@ class Message:
     # --- wire format: JSON header + raw array segments ---
     MAGIC = b"FTM1"
 
+    def frame(self) -> "FramedMessage":
+        """Encode this message once into a reusable wire frame (the
+        broadcast fan-out primitive — see :class:`FramedMessage`)."""
+        return FramedMessage(self)
+
     def to_bytes(self) -> bytes:
-        header: dict[str, Any] = {}
-        arrays: list[np.ndarray] = []
-        for k, v in self.msg_params.items():
-            if isinstance(v, (np.ndarray, jax.Array)):
-                a = np.ascontiguousarray(np.asarray(v))
-                header[k] = {"__arr__": len(arrays), "dtype": str(a.dtype), "shape": list(a.shape)}
-                arrays.append(a)
-            else:
-                header[k] = v
-        hbytes = json.dumps(header).encode()
-        buf = io.BytesIO()
-        buf.write(self.MAGIC)
-        buf.write(struct.pack("<I", len(hbytes)))
-        buf.write(hbytes)
-        for a in arrays:
-            raw = a.tobytes()
-            buf.write(struct.pack("<Q", len(raw)))
-            buf.write(raw)
-        return buf.getvalue()
+        return self.frame().bytes_for(self.get_receiver_id())
 
     @classmethod
-    def from_bytes(cls, data: bytes) -> "Message":
-        assert data[:4] == cls.MAGIC, "bad message magic"
-        (hlen,) = struct.unpack_from("<I", data, 4)
-        header = json.loads(data[8 : 8 + hlen].decode())
-        offset = 8 + hlen
-        # collect array descriptors in insertion order
+    def from_bytes(cls, data) -> "Message":
+        """Decode a wire frame. Array params are zero-copy read-only views
+        into ``data`` (bytes, bytearray, or memoryview) — they stay valid as
+        long as the message (which keeps ``data`` alive) does."""
+        mv = memoryview(data)
+        assert bytes(mv[:4]) == cls.MAGIC, "bad message magic"
+        (hlen,) = struct.unpack_from("<I", mv, 4)
+        header = json.loads(bytes(mv[8 : 8 + hlen]).decode())
+        return cls._from_header_and_tail(header, mv[8 + hlen :])
+
+    @classmethod
+    def from_buffers(cls, head, tail) -> "Message":
+        """Decode a two-part frame: ``head`` (magic + header) and ``tail``
+        (the shared payload segments). The loopback backend posts broadcast
+        fan-outs this way so every receiver's arrays view ONE shared payload
+        buffer — zero per-receiver payload copies."""
+        hv = memoryview(head)
+        assert bytes(hv[:4]) == cls.MAGIC, "bad message magic"
+        (hlen,) = struct.unpack_from("<I", hv, 4)
+        header = json.loads(bytes(hv[8 : 8 + hlen]).decode())
+        return cls._from_header_and_tail(header, memoryview(tail))
+
+    @classmethod
+    def _from_header_and_tail(cls, header: dict, tail: memoryview) -> "Message":
+        # collect array descriptors in segment order
         descs = [(k, v) for k, v in header.items() if isinstance(v, dict) and "__arr__" in v]
         descs.sort(key=lambda kv: kv[1]["__arr__"])
         arrays = {}
+        offset = 0
         for k, d in descs:
-            (alen,) = struct.unpack_from("<Q", data, offset)
+            (alen,) = struct.unpack_from("<Q", tail, offset)
             offset += 8
-            arr = np.frombuffer(data, dtype=np.dtype(d["dtype"]), count=int(np.prod(d["shape"])) if d["shape"] else 1, offset=offset)
+            arr = np.frombuffer(
+                tail, dtype=np.dtype(d["dtype"]),
+                count=int(np.prod(d["shape"])) if d["shape"] else 1, offset=offset,
+            )
+            # wire views are read-only even when the source buffer is
+            # mutable: receivers must never alias-write a (possibly shared)
+            # transport buffer
+            arr.flags.writeable = False
             arrays[k] = arr.reshape(d["shape"])
             offset += alen
         msg = cls()
@@ -127,6 +178,141 @@ class Message:
         return f"Message({sizes})"
 
 
+# --- encode-once wire frame --------------------------------------------------
+
+# the receiver slot is rendered as an 11-char fixed-width decimal so it can
+# be patched in place per receiver; whitespace padding keeps the header
+# valid JSON ("receiver":         3)
+_RECV_SENTINEL = -1097393539
+_RECV_WIDTH = len(str(_RECV_SENTINEL))
+
+
+class FramedMessage:
+    """One message encoded once, emittable to many receivers.
+
+    ``Message.to_bytes`` used to re-pack the full payload per call, so a
+    model broadcast to N workers serialized the model N times. A frame holds
+    the payload segments as zero-copy memoryviews plus a header template
+    with a fixed-width receiver slot; ``bytes_for(dst)`` patches the slot in
+    place (an O(header) operation) and joins the shared segments. Small
+    per-receiver header params (e.g. the assigned client index) ride
+    ``overrides`` — a cheap header re-dump, never a payload re-pack.
+    Overriding array params is rejected: it would orphan a payload segment.
+    """
+
+    __slots__ = ("_header", "_arrays", "_tail", "_head", "_slot",
+                 "_tail_bytes", "payload_nbytes")
+
+    def __init__(self, msg: Message):
+        header: dict[str, Any] = {}
+        arrays: list[np.ndarray] = []
+        for k, v in msg.msg_params.items():
+            if isinstance(v, (np.ndarray, jax.Array)):
+                a = np.ascontiguousarray(np.asarray(v))
+                header[k] = {"__arr__": len(arrays), "dtype": str(a.dtype),
+                             "shape": list(a.shape)}
+                arrays.append(a)
+            else:
+                header[k] = v
+        self._header = header
+        self._arrays = arrays  # keeps the segment buffers alive
+        tail: list = []
+        nbytes = 0
+        for a in arrays:
+            seg = memoryview(_byte_view(a))
+            tail.append(struct.pack("<Q", seg.nbytes))
+            tail.append(seg)
+            nbytes += seg.nbytes
+        self._tail = tail
+        self._tail_bytes: bytes | None = None
+        self.payload_nbytes = nbytes
+        # header template with the fixed-width receiver slot
+        probe = dict(header)
+        probe[Message.MSG_ARG_KEY_RECEIVER] = _RECV_SENTINEL
+        hb = json.dumps(probe).encode()
+        token = b'"%s": %d' % (Message.MSG_ARG_KEY_RECEIVER.encode(),
+                               _RECV_SENTINEL)
+        self._head = None
+        self._slot = None
+        if hb.count(token) == 1:
+            # JSON string escaping makes a str-param collision impossible;
+            # a nested dict param repeating key+sentinel falls back to the
+            # re-dump path below
+            at = hb.index(token) + len(token) - _RECV_WIDTH
+            self._head = Message.MAGIC + struct.pack("<I", len(hb)) + hb
+            self._slot = 8 + at
+        with _WIRE_LOCK:
+            _WIRE_STATS["frames"] += 1
+            if arrays:
+                _WIRE_STATS["payload_serializations"] += 1
+
+    def head_for(self, receiver: int, overrides: dict | None = None) -> bytes:
+        rid = int(receiver)
+        if overrides is None and self._slot is not None:
+            tok = b"%*d" % (_RECV_WIDTH, rid)
+            if len(tok) == _RECV_WIDTH:
+                head = bytearray(self._head)
+                head[self._slot : self._slot + _RECV_WIDTH] = tok
+                return bytes(head)
+        h = dict(self._header)
+        if overrides:
+            for k, v in overrides.items():
+                if isinstance(v, (np.ndarray, jax.Array)):
+                    raise ValueError(
+                        f"broadcast override {k!r} is an array: per-receiver "
+                        "overrides are header-only (share the payload, vary "
+                        "the scalars)"
+                    )
+                tmpl = self._header.get(k)
+                if isinstance(tmpl, dict) and "__arr__" in tmpl:
+                    raise ValueError(
+                        f"cannot override array param {k!r}: it is a framed "
+                        "payload segment"
+                    )
+                h[k] = v
+        h[Message.MSG_ARG_KEY_RECEIVER] = rid
+        hb = json.dumps(h).encode()
+        return Message.MAGIC + struct.pack("<I", len(hb)) + hb
+
+    def tail_bytes(self) -> bytes:
+        """The payload segments joined once (lazily cached) — shared across
+        every receiver of a broadcast."""
+        tb = self._tail_bytes
+        if tb is None:
+            tb = self._tail_bytes = b"".join(self._tail)
+        return tb
+
+    def buffers_for(self, receiver: int, overrides: dict | None = None) -> list:
+        """Vectored form: ``[head, len0, seg0, len1, seg1, ...]`` — the
+        payload entries are zero-copy views of the original arrays."""
+        return [self.head_for(receiver, overrides), *self._tail]
+
+    def bytes_for(self, receiver: int, overrides: dict | None = None) -> bytes:
+        """Contiguous wire bytes for one receiver (for byte-oriented
+        transports: one join, no payload re-serialization)."""
+        return self.head_for(receiver, overrides) + self.tail_bytes()
+
+    def to_message(self, receiver: int, overrides: dict | None = None) -> Message:
+        """Rebuild a Message addressed to ``receiver`` whose array params
+        share this frame's buffers — the fallback for backends without a
+        bytes-level framed-send hook."""
+        msg = Message()
+        msg.msg_params = dict(self._header)
+        for k, v in list(msg.msg_params.items()):
+            if isinstance(v, dict) and "__arr__" in v:
+                msg.msg_params[k] = self._arrays[v["__arr__"]]
+        if overrides:
+            for k, v in overrides.items():
+                if isinstance(v, (np.ndarray, jax.Array)):
+                    raise ValueError(
+                        f"broadcast override {k!r} is an array: per-receiver "
+                        "overrides are header-only"
+                    )
+                msg.msg_params[k] = v
+        msg.msg_params[Message.MSG_ARG_KEY_RECEIVER] = int(receiver)
+        return msg
+
+
 # --- pytree <-> wire payload -------------------------------------------------
 
 
@@ -135,7 +321,8 @@ def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
     The descriptor records leaf paths/shapes/dtypes so the receiver rebuilds
     the exact structure — the anti-pickle wire contract (SURVEY §5.8).
     Leaves keep their native dtypes byte-for-byte (int64 counters and f64
-    leaves survive the wire unchanged)."""
+    leaves survive the wire unchanged). Each leaf contributes a zero-copy
+    byte view; the single concatenation into ``flat`` is the only copy."""
     from fedml_tpu.core.tree import tree_leaves_with_paths
 
     leaves = tree_leaves_with_paths(tree)
@@ -144,10 +331,7 @@ def pack_pytree(tree: Any) -> tuple[np.ndarray, str]:
         for k, v in leaves
     ]
     if leaves:
-        flat = np.concatenate(
-            [np.frombuffer(np.ascontiguousarray(np.asarray(v)).tobytes(), np.uint8)
-             for _, v in leaves]
-        )
+        flat = np.concatenate([_byte_view(np.asarray(v)) for _, v in leaves])
     else:
         flat = np.zeros((0,), np.uint8)
     return flat, json.dumps(desc)
@@ -208,16 +392,30 @@ def unpack_encoded_update(flat: np.ndarray, descriptor: str):
 
 
 def unpack_pytree(flat: np.ndarray, descriptor: str) -> Any:
-    """Rebuild a nested dict from pack_pytree output (paths use '/')."""
+    """Rebuild a nested dict from pack_pytree output (paths use '/').
+
+    Leaves are alignment-safe zero-copy views into ``flat``, always marked
+    read-only (matching the pre-view wire semantics, where every leaf was a
+    frombuffer-of-bytes copy): a writable alias would let a consumer — e.g.
+    a round callback handed views of the server's live global model —
+    silently corrupt the source buffer. A leaf whose byte offset is
+    misaligned for its dtype falls back to a copy."""
     desc = json.loads(descriptor)
     flat = np.asarray(flat, dtype=np.uint8)
+    viewable = flat.flags.c_contiguous
+    base_addr = flat.ctypes.data if viewable else 0
     out: dict[str, Any] = {}
     i = 0
     for d in desc:
         dt = np.dtype(d["dtype"])
         n = int(np.prod(d["shape"])) if d["shape"] else 1
         nbytes = n * dt.itemsize
-        leaf = np.frombuffer(flat[i : i + nbytes].tobytes(), dtype=dt).reshape(d["shape"])
+        if viewable and (base_addr + i) % dt.itemsize == 0:
+            view = flat[i : i + nbytes].view(dt)
+            view.flags.writeable = False
+            leaf = view.reshape(d["shape"])
+        else:
+            leaf = np.frombuffer(flat[i : i + nbytes].tobytes(), dtype=dt).reshape(d["shape"])
         i += nbytes
         node = out
         parts = d["path"].split("/")

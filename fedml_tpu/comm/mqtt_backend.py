@@ -138,6 +138,13 @@ class MqttCommManager(BaseCommunicationManager):
         info = self.client.publish(topic, msg.to_bytes(), qos=1)
         info.wait_for_publish()
 
+    def _send_framed(self, frame, dst: int, overrides: dict | None = None) -> None:
+        # encode-once broadcast: per-receiver topics, shared payload bytes
+        info = self.client.publish(
+            self._send_topic(dst), frame.bytes_for(dst, overrides), qos=1
+        )
+        info.wait_for_publish()
+
     def handle_receive_message(self) -> None:
         while not self._stop.is_set():
             try:

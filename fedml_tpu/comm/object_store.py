@@ -107,6 +107,10 @@ _OFFLOADED = "__offloaded__"  # header key: {param_key: store_key, ...}
 # store too — raw utf-8 blobs under their own header so the receive side
 # restores a str, not an array
 _OFFLOADED_TEXT = "__offloaded_text__"
+# marker on broadcast control messages: the referenced blobs are shared by
+# every receiver of the fan-out, so receiver-side cleanup is suppressed and
+# the SENDER retires them generationally instead
+_OFFLOAD_SHARED = "__offload_shared__"
 
 
 class OffloadCommManager(BaseCommunicationManager):
@@ -117,21 +121,30 @@ class OffloadCommManager(BaseCommunicationManager):
     """
 
     def __init__(self, inner: BaseCommunicationManager, store: ObjectStore,
-                 threshold_bytes: int = 1 << 16, cleanup: bool = True):
+                 threshold_bytes: int = 1 << 16, cleanup: bool = True,
+                 broadcast_generations: int = 2):
         super().__init__()
         self.inner = inner
         self.store = store
         self.threshold = threshold_bytes
         self.cleanup = cleanup
+        # broadcast blobs are shared by all receivers, so the sender retires
+        # them: a generation is deleted once `broadcast_generations` newer
+        # fan-outs exist (2 keeps a one-round-stale straggler downloadable)
+        self.broadcast_generations = max(1, int(broadcast_generations))
+        self._bcast_lock = threading.Lock()
+        self._bcast_gens: list[list[str]] = []
         self._resolver = _Resolver(self)
         self.inner.add_observer(self._resolver)
 
     # -- send path ----------------------------------------------------------
 
-    def send_message(self, msg: Message) -> None:
-        # Work on a shallow copy: the caller's Message must stay intact so it
-        # can be reused for further receivers (each send uploads fresh blobs,
-        # which matters with cleanup=True — the first receiver deletes them).
+    def _offload_params(self, msg: Message) -> tuple[Message, dict[str, str], dict[str, str]]:
+        """Upload every over-threshold array/text param once and strip it
+        from a shallow copy of ``msg`` (the caller's Message stays intact so
+        it can be reused). Returns (stripped message, array key table, text
+        key table) — one definition shared by the per-receiver and broadcast
+        send paths."""
         offloaded: dict[str, str] = {}
         offloaded_text: dict[str, str] = {}
         out = Message()
@@ -151,11 +164,44 @@ class OffloadCommManager(BaseCommunicationManager):
             out.add_params(_OFFLOADED, offloaded)
         if offloaded_text:
             out.add_params(_OFFLOADED_TEXT, offloaded_text)
+        return out, offloaded, offloaded_text
+
+    def send_message(self, msg: Message) -> None:
+        # each send uploads fresh blobs, which matters with cleanup=True —
+        # the first receiver deletes them
+        out, _, _ = self._offload_params(msg)
         self.inner.send_message(out)
+
+    def broadcast_message(self, msg: Message, receiver_ids,
+                          per_receiver: dict[int, dict] | None = None) -> None:
+        """Encode-once for the data plane too: each large payload is uploaded
+        to the store ONCE for the whole fan-out (vs once per receiver on the
+        legacy path) and every receiver resolves the same key. Shared blobs
+        are retired by the sender once ``broadcast_generations`` newer
+        fan-outs exist — safe in round-synchronous protocols, where a
+        receiver is at most one round stale before being dropped."""
+        out, offloaded, offloaded_text = self._offload_params(msg)
+        if offloaded or offloaded_text:
+            out.add_params(_OFFLOAD_SHARED, 1)
+            stale: list[str] = []
+            with self._bcast_lock:
+                self._bcast_gens.append(
+                    list(offloaded.values()) + list(offloaded_text.values())
+                )
+                while len(self._bcast_gens) > self.broadcast_generations:
+                    stale.extend(self._bcast_gens.pop(0))
+            if self.cleanup:
+                for key in stale:
+                    try:
+                        self.store.delete(key)
+                    except OSError:
+                        pass
+        self.inner.broadcast_message(out, receiver_ids, per_receiver)
 
     # -- receive path -------------------------------------------------------
 
     def _resolve(self, msg: Message) -> Message:
+        shared = bool(msg.get(_OFFLOAD_SHARED))
         for header, restore in ((_OFFLOADED, _bytes_array),
                                 (_OFFLOADED_TEXT, lambda b: b.decode("utf-8"))):
             table = msg.get(header)
@@ -163,19 +209,38 @@ class OffloadCommManager(BaseCommunicationManager):
                 continue
             for param_key, store_key in table.items():
                 msg.add_params(param_key, restore(self.store.get(store_key)))
-                if self.cleanup:
+                if self.cleanup and not shared:
                     try:
                         self.store.delete(store_key)
                     except OSError:
                         pass
             del msg.msg_params[header]
+        msg.msg_params.pop(_OFFLOAD_SHARED, None)
         return msg
 
     def handle_receive_message(self) -> None:
         self.inner.handle_receive_message()
 
     def stop_receive_message(self) -> None:
+        # The last `broadcast_generations` fan-outs' blobs deliberately
+        # OUTLIVE the sender: the final stop broadcast is usually still being
+        # resolved by receivers when the sender stops, and deleting under
+        # them fails their receive threads. Bounded leak (generation rotation
+        # retires everything older); harnesses that know the protocol fully
+        # drained can call retire_broadcast_blobs().
         self.inner.stop_receive_message()
+
+    def retire_broadcast_blobs(self) -> None:
+        """Delete ALL shared broadcast blobs this sender still tracks. Only
+        safe once every receiver has resolved the final fan-out."""
+        with self._bcast_lock:
+            gens, self._bcast_gens = self._bcast_gens, []
+        for keys in gens:
+            for key in keys:
+                try:
+                    self.store.delete(key)
+                except OSError:
+                    pass
 
 
 class _Resolver(Observer):

@@ -1,0 +1,115 @@
+"""Bounded send-worker pool for concurrent downlink fan-out.
+
+The reference server (and this repo's managers until the wire-path rebuild)
+sent every downlink message as a blocking unary call on the manager thread:
+a broadcast to N workers serialized N round-trips — each with a multi-minute
+timeout budget — before the receive loop could run again. The pool runs the
+per-receiver sends of one broadcast concurrently so downlink wall time is
+the slowest single send, not the sum.
+
+Ordering contract: each destination is hashed to ONE worker thread, so two
+sends to the same receiver can never reorder (the per-backend FIFO the
+protocol layers rely on survives pooling); sends to different receivers run
+concurrently. :meth:`SendWorkerPool.run_all` is a barrier — it returns after
+every submitted send completed and re-raises the first send error — so a
+broadcast call keeps its synchronous semantics while its legs overlap.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+
+class SendWorkerPool:
+    """K worker threads, each owning a FIFO; destinations hash to workers."""
+
+    def __init__(self, workers: int = 4, name: str = "comm-send"):
+        self.workers = max(1, int(workers))
+        self._name = name
+        self._queues: list[queue.SimpleQueue] = [
+            queue.SimpleQueue() for _ in range(self.workers)
+        ]
+        self._threads: list[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._started = False
+        self._closed = False
+
+    def _ensure_started(self) -> None:
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(f"send pool {self._name!r} is closed")
+            if self._started:
+                return
+            for i, q in enumerate(self._queues):
+                t = threading.Thread(
+                    target=self._worker, args=(q,),
+                    name=f"{self._name}-{i}", daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+            self._started = True
+
+    @staticmethod
+    def _worker(q: queue.SimpleQueue) -> None:
+        while True:
+            fn = q.get()
+            if fn is None:
+                return
+            fn()
+
+    def run_all(self, tasks: list[tuple[int, Callable[[], None]]],
+                timeout: float | None = None) -> None:
+        """Run ``(destination, send_fn)`` tasks on the pool and block until
+        all complete. Same-destination tasks run in submission order on one
+        worker; distinct destinations overlap. Raises the first send error
+        (remaining sends still run to completion first)."""
+        if not tasks:
+            return
+        self._ensure_started()
+        errors: list[BaseException] = []
+        done = threading.Event()
+        state_lock = threading.Lock()
+        remaining = [len(tasks)]
+
+        def wrap(fn: Callable[[], None]) -> Callable[[], None]:
+            def run() -> None:
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    errors.append(e)
+                finally:
+                    with state_lock:
+                        remaining[0] -= 1
+                        if remaining[0] == 0:
+                            done.set()
+            return run
+
+        for dst, fn in tasks:
+            self._queues[hash(dst) % self.workers].put(wrap(fn))
+        if not done.wait(timeout):
+            raise TimeoutError(
+                f"{remaining[0]} of {len(tasks)} pooled sends still pending "
+                f"after {timeout}s"
+            )
+        if errors:
+            raise errors[0]
+
+    def close(self, timeout: float = 5.0) -> None:
+        """Stop the workers (idempotent). Queued work submitted before close
+        still drains; ``run_all`` after close raises."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._started
+        if started:
+            for q in self._queues:
+                q.put(None)
+            for t in self._threads:
+                t.join(timeout)
+
+    @property
+    def alive_workers(self) -> int:
+        return sum(t.is_alive() for t in self._threads)

@@ -112,12 +112,19 @@ class ShmCommManager(BaseCommunicationManager):
     def _ring_name(self, rank: int) -> str:
         return f"/{self.job}_r{rank}"
 
-    def send_message(self, msg: Message) -> None:
-        dst = msg.get_receiver_id()
+    def _ring(self, dst: int) -> ShmRing:
         if dst not in self._out:
             # receiver creates its ring at startup; create= True is idempotent
             self._out[dst] = ShmRing(self._ring_name(dst), self.capacity, create=True)
-        self._out[dst].send(msg.to_bytes())
+        return self._out[dst]
+
+    def send_message(self, msg: Message) -> None:
+        self._ring(msg.get_receiver_id()).send(msg.to_bytes())
+
+    def _send_framed(self, frame, dst: int, overrides: dict | None = None) -> None:
+        # encode-once: the shared frame tail is joined once per fan-out; each
+        # receiver's ring write reuses it behind a patched header
+        self._ring(dst).send(frame.bytes_for(dst, overrides))
 
     def handle_receive_message(self) -> None:
         self._running = True

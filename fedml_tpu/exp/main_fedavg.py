@@ -72,6 +72,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--offload_threshold_bytes", type=int, default=1 << 14,
                         help="arrays >= this many bytes ride the object "
                              "store instead of the MQTT control plane")
+    parser.add_argument("--grpc_send_timeout", type=float, default=600.0,
+                        help="per-send unary deadline (seconds) on the gRPC "
+                             "transport (was hardcoded 600)")
+    parser.add_argument("--grpc_send_workers", type=int, default=4,
+                        help="broadcast send-pool width on the gRPC "
+                             "transport; 0 = serial fan-out on the manager "
+                             "thread (docs/PERFORMANCE.md server wire path)")
     # algorithm switch (fedall) + algorithm-specific knobs
     parser.add_argument("--algorithm", type=str, default="fedavg",
                         choices=["fedavg", "fedopt", "fedprox", "fednova", "fedgan",
@@ -299,7 +306,11 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     runners = {
         "loopback": run_distributed_fedavg_loopback,
         "shm": run_distributed_fedavg_shm,
-        "grpc": run_distributed_fedavg_grpc,
+        "grpc": functools.partial(
+            run_distributed_fedavg_grpc,
+            send_timeout=getattr(args, "grpc_send_timeout", 600.0),
+            send_workers=getattr(args, "grpc_send_workers", 4),
+        ),
         "mqtt_s3": functools.partial(
             run_distributed_fedavg_mqtt_s3,
             store_dir=args.object_store_dir,

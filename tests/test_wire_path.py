@@ -1,0 +1,564 @@
+"""Server wire-path tests (docs/PERFORMANCE.md "The server wire path"):
+encode-once broadcast framing, zero-copy pack/unpack view semantics,
+streaming (accumulate-on-arrival) aggregation vs the buffered reference,
+the bounded send-worker pool, and the tier-1 wire smoke."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    BufferedFedAvgDistAggregator,
+    CompressedBufferedDistAggregator,
+    CompressedDistAggregator,
+    EmptyRoundError,
+    FedAvgDistAggregator,
+)
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+from fedml_tpu.comm.message import (
+    Message,
+    pack_pytree,
+    reset_wire_stats,
+    unpack_pytree,
+    wire_stats,
+)
+from fedml_tpu.comm.send_pool import SendWorkerPool
+
+
+# ---------------------------------------------------------------------------
+# encode-once framing
+# ---------------------------------------------------------------------------
+
+
+def test_frame_counts_one_serialization_across_receivers():
+    m = Message(2, 0, 1)
+    m.add_params("model_params", np.arange(64, dtype=np.float32))
+    reset_wire_stats()
+    frame = m.frame()
+    for dst in range(1, 6):
+        frame.bytes_for(dst)
+    assert wire_stats()["payload_serializations"] == 1
+    # the legacy per-receiver path pays once per call
+    reset_wire_stats()
+    for dst in range(1, 6):
+        m.msg_params[Message.MSG_ARG_KEY_RECEIVER] = dst
+        m.to_bytes()
+    assert wire_stats()["payload_serializations"] == 5
+
+
+def test_frame_receiver_patch_roundtrip():
+    m = Message(3, 0, 7)
+    m.add_params("x", np.arange(6, dtype=np.int32))
+    m.add_params("note", "hello")
+    frame = m.frame()
+    for dst in (1, 12, 4096):
+        got = Message.from_bytes(frame.bytes_for(dst))
+        assert got.get_receiver_id() == dst
+        assert got.get_sender_id() == 0 and got.get_type() == 3
+        assert got.get("note") == "hello"
+        np.testing.assert_array_equal(got.get("x"), m.get("x"))
+
+
+def test_frame_per_receiver_overrides():
+    m = Message(2, 0, 1)
+    m.add_params("model_params", np.ones(8, np.float32))
+    frame = m.frame()
+    a = Message.from_bytes(frame.bytes_for(1, {"client_idx": 5}))
+    b = Message.from_bytes(frame.bytes_for(2, {"client_idx": 9}))
+    assert a.get("client_idx") == 5 and b.get("client_idx") == 9
+    np.testing.assert_array_equal(a.get("model_params"), b.get("model_params"))
+    # overrides are header-only: array values and framed params are rejected
+    with pytest.raises(ValueError, match="header-only"):
+        frame.bytes_for(1, {"client_idx": np.zeros(3, np.float32)})
+    with pytest.raises(ValueError, match="payload segment"):
+        frame.bytes_for(1, {"model_params": 0})
+
+
+def test_broadcast_loopback_matches_per_rank_sends():
+    """Broadcast delivery is byte-equivalent to per-rank sends, and every
+    receiver of one broadcast views ONE shared payload buffer."""
+    fabric = LoopbackFabric(4)
+    mgrs = {r: LoopbackCommManager(fabric, r) for r in range(4)}
+    received: dict[int, Message] = {}
+
+    class Obs:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def receive_message(self, t, m):
+            received[self.rank] = m
+            mgrs[self.rank].stop_receive_message()
+
+    threads = []
+    for r in (1, 2, 3):
+        mgrs[r].add_observer(Obs(r))
+        th = threading.Thread(target=mgrs[r].handle_receive_message, daemon=True)
+        th.start()
+        threads.append(th)
+
+    payload = np.arange(100, dtype=np.float32)
+    msg = Message(5, 0, 1)
+    msg.add_params("model_params", payload)
+    mgrs[0].broadcast_message(
+        msg, [1, 2, 3], per_receiver={r: {"client_idx": r * 10} for r in (1, 2, 3)}
+    )
+    for th in threads:
+        th.join(timeout=10)
+    assert sorted(received) == [1, 2, 3]
+    for r in (1, 2, 3):
+        got = received[r]
+        assert got.get_receiver_id() == r and got.get("client_idx") == r * 10
+        arr = got.get("model_params")
+        np.testing.assert_array_equal(arr, payload)
+        assert not arr.flags.writeable  # shared wire buffer is read-only
+    # zero per-receiver payload copies: all three view the same buffer
+    assert np.shares_memory(np.asarray(received[1].get("model_params")),
+                            np.asarray(received[2].get("model_params")))
+
+
+def test_broadcast_inproc_mqtt_backend():
+    """Encode-once broadcast over the MQTT topic scheme (in-process broker):
+    one payload serialization for the whole fan-out."""
+    from fedml_tpu.comm.inproc_broker import InProcessBroker
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+    factory = InProcessBroker().client_factory()
+    server = MqttCommManager("inproc", 0, topic="wt", client_id=0,
+                             client_num=2, client_factory=factory)
+    clients = {
+        r: MqttCommManager("inproc", 0, topic="wt", client_id=r,
+                           client_num=2, client_factory=factory)
+        for r in (1, 2)
+    }
+    msg = Message(4, 0, 1)
+    msg.add_params("w", np.arange(12, dtype=np.float32))
+    reset_wire_stats()
+    server.broadcast_message(msg, [1, 2])
+    assert wire_stats()["payload_serializations"] == 1
+    for r, c in clients.items():
+        got = c._q.get(timeout=5)
+        assert got.get_receiver_id() == r
+        np.testing.assert_array_equal(got.get("w"), msg.get("w"))
+    for m in [server, *clients.values()]:
+        m.stop_receive_message()
+
+
+def test_broadcast_object_store_single_put(tmp_path):
+    """OffloadCommManager broadcast uploads each large payload ONCE; shared
+    blobs survive receiver resolution and are retired generationally."""
+    from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+    puts = []
+
+    class CountingStore(FileSystemStore):
+        def put(self, key, data):
+            puts.append(key)
+            super().put(key, data)
+
+    store = CountingStore(tmp_path / "store")
+    fabric = LoopbackFabric(3)
+    mgrs = {
+        r: OffloadCommManager(LoopbackCommManager(fabric, r), store,
+                              threshold_bytes=256)
+        for r in range(3)
+    }
+    received = {}
+
+    class Obs:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def receive_message(self, t, m):
+            received[self.rank] = m
+            mgrs[self.rank].inner.stop_receive_message()
+
+    threads = []
+    for r in (1, 2):
+        mgrs[r].add_observer(Obs(r))
+        th = threading.Thread(target=mgrs[r].handle_receive_message, daemon=True)
+        th.start()
+        threads.append(th)
+
+    big = np.arange(1024, dtype=np.float32)
+    msg = Message(5, 0, 1)
+    msg.add_params("model_params", big)
+    mgrs[0].broadcast_message(msg, [1, 2])
+    for th in threads:
+        th.join(timeout=10)
+    assert len(puts) == 1  # one upload for the whole fan-out
+    for r in (1, 2):
+        np.testing.assert_array_equal(received[r].get("model_params"), big)
+        assert "__offload_shared__" not in received[r].msg_params
+    # shared blob NOT deleted by receivers...
+    assert len(list((tmp_path / "store").glob("model_params-*"))) == 1
+    # ...and retired once broadcast_generations newer fan-outs exist (the
+    # live generations outlive the sender's stop so slow receivers can
+    # still resolve the final fan-out)
+    mgrs[0].broadcast_message(msg, [1, 2])
+    mgrs[0].broadcast_message(msg, [1, 2])
+    assert len(list((tmp_path / "store").glob("model_params-*"))) == 2
+    mgrs[0].stop_receive_message()
+    assert len(list((tmp_path / "store").glob("model_params-*"))) == 2
+    mgrs[0].retire_broadcast_blobs()  # explicit drain-complete cleanup
+    assert list((tmp_path / "store").glob("model_params-*")) == []
+
+
+# ---------------------------------------------------------------------------
+# zero-copy pack/unpack view semantics
+# ---------------------------------------------------------------------------
+
+
+def test_from_bytes_arrays_are_readonly_views():
+    m = Message(1, 0, 1)
+    m.add_params("x", np.arange(32, dtype=np.float32))
+    data = m.to_bytes()
+    got = Message.from_bytes(data)
+    arr = got.get("x")
+    assert not arr.flags.writeable
+    assert np.shares_memory(arr, np.frombuffer(data, np.uint8))
+    with pytest.raises(ValueError):
+        arr[0] = 1.0
+
+
+def test_frame_payload_segments_share_memory_with_source():
+    a = np.arange(64, dtype=np.float32)
+    m = Message(1, 0, 1)
+    m.add_params("x", a)
+    frame = m.frame()
+    bufs = frame.buffers_for(1)
+    # [head, len-prefix, segment]: the segment views the source array
+    seg = np.frombuffer(bufs[-1], np.uint8)
+    assert np.shares_memory(seg, a)
+
+
+def test_unpack_pytree_aligned_views_and_misaligned_copies():
+    tree = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "b": np.ones(4, np.float32)}
+    flat, desc = pack_pytree(tree)
+    out = unpack_pytree(flat, desc)
+    for k in tree:
+        np.testing.assert_array_equal(out[k], tree[k])
+        assert np.shares_memory(out[k], flat), k  # aligned: zero-copy view
+        # read-only even over a WRITABLE flat: a round callback handed views
+        # of the server's live global model must not be able to corrupt it
+        assert not out[k].flags.writeable, k
+    # a leading odd-size uint8 leaf misaligns the f32 leaf -> safe copy
+    tree2 = {"a": np.asarray([7], np.uint8), "w": np.arange(4, dtype=np.float32)}
+    flat2, desc2 = pack_pytree(tree2)
+    out2 = unpack_pytree(flat2, desc2)
+    np.testing.assert_array_equal(out2["w"], tree2["w"])
+    assert not np.shares_memory(out2["w"], flat2)
+    # wire-received payloads stay read-only through unpack
+    m = Message(1, 0, 1)
+    m.add_params("model_params", flat)
+    got = Message.from_bytes(m.to_bytes())
+    leaves = unpack_pytree(np.asarray(got.get("model_params")), desc)
+    assert not leaves["w"].flags.writeable
+
+
+def test_pack_pytree_preserves_dtypes_and_layout():
+    """The zero-copy rewrite keeps the wire layout byte-identical."""
+    tree = {"count": np.array(16_777_217, np.int64),
+            "w": np.ones((2, 3), np.float32)}
+    flat, desc = pack_pytree(tree)
+    legacy = np.concatenate([
+        np.frombuffer(np.ascontiguousarray(v).tobytes(), np.uint8)
+        for v in (tree["count"], tree["w"])
+    ])
+    np.testing.assert_array_equal(flat, legacy)
+    back = unpack_pytree(flat, desc)
+    assert back["count"].dtype == np.int64
+    np.testing.assert_array_equal(back["count"], tree["count"])
+
+
+# ---------------------------------------------------------------------------
+# streaming vs buffered aggregation
+# ---------------------------------------------------------------------------
+
+
+def _payloads(n_workers, size=33, seed=0):
+    rng = np.random.RandomState(seed)
+    flats = [rng.randn(size).astype(np.float32).view(np.uint8)
+             for _ in range(n_workers)]
+    weights = [float(w) for w in rng.randint(1, 50, n_workers)]
+    return flats, weights
+
+
+@pytest.mark.parametrize("order", [[0, 1, 2, 3], [3, 1, 0, 2]])
+def test_streaming_matches_buffered_bitwise(order):
+    flats, weights = _payloads(4)
+    stream, buf = FedAvgDistAggregator(4), BufferedFedAvgDistAggregator(4)
+    for i in order:
+        assert stream.add_local_trained_result(i, flats[i], weights[i]) == (i == order[-1])
+        buf.add_local_trained_result(i, flats[i], weights[i])
+    out_s, out_b = stream.aggregate(), buf.aggregate()
+    np.testing.assert_array_equal(out_s, out_b)
+    # weighted-mean sanity
+    x = np.stack([f.view(np.float32) for f in flats]).astype(np.float64)
+    w = np.asarray(weights, np.float64)
+    np.testing.assert_allclose(
+        out_s.view(np.float32), (w @ x) / w.sum(), rtol=1e-6
+    )
+
+
+def test_streaming_holds_no_per_worker_payloads():
+    agg = FedAvgDistAggregator(8)
+    assert not hasattr(agg, "model_dict")
+    flats, weights = _payloads(8, size=100)
+    for i in range(8):
+        agg.add_local_trained_result(i, flats[i], weights[i])
+    # one model-sized f64 accumulator, nothing else retained
+    assert agg._acc is not None and agg._acc.size == 100
+    agg.aggregate()
+    assert agg._acc is None
+
+
+def test_streaming_dropped_straggler_renormalization():
+    """Only a subset uploads (timeout dropped the rest): weights renormalize
+    over the subset, identically in both tallies."""
+    flats, weights = _payloads(5, seed=3)
+    stream, buf = FedAvgDistAggregator(5), BufferedFedAvgDistAggregator(5)
+    for i in (4, 0, 2):  # workers 1 and 3 dropped
+        stream.add_local_trained_result(i, flats[i], weights[i])
+        buf.add_local_trained_result(i, flats[i], weights[i])
+    out_s, out_b = stream.aggregate(), buf.aggregate()
+    np.testing.assert_array_equal(out_s, out_b)
+    x = np.stack([flats[i].view(np.float32) for i in (4, 0, 2)]).astype(np.float64)
+    w = np.asarray([weights[i] for i in (4, 0, 2)], np.float64)
+    np.testing.assert_allclose(out_s.view(np.float32), (w @ x) / w.sum(),
+                               rtol=1e-6)
+
+
+def test_aggregate_empty_round_raises_clear_error():
+    for agg in (FedAvgDistAggregator(3), BufferedFedAvgDistAggregator(3)):
+        with pytest.raises(EmptyRoundError, match="no worker uploads"):
+            agg.aggregate()
+
+
+def test_exclude_after_upload_rejected():
+    flats, weights = _payloads(2)
+    agg = FedAvgDistAggregator(2)
+    agg.add_local_trained_result(0, flats[0], weights[0])
+    with pytest.raises(ValueError, match="cannot retract"):
+        agg.exclude_worker(0)
+    agg.exclude_worker(1)  # missing worker: fine
+    assert agg.live_workers() == [0]
+
+
+@pytest.mark.parametrize("spec", ["none", "topk", "q8"])
+def test_compressed_streaming_matches_buffered(spec):
+    import jax
+
+    from fedml_tpu.compress import make_codec
+
+    codec = make_codec(spec, topk_frac=0.25)
+    rng = np.random.RandomState(7)
+    base = rng.randn(40).astype(np.float32)
+    tree = {"w": base.reshape(8, 5)}
+    encs, weights = [], [3.0, 1.0, 5.0]
+    for i in range(3):
+        delta = {"w": np.asarray(rng.randn(8, 5), np.float32)}
+        encs.append(jax.tree.map(
+            np.asarray, codec.encode(delta, jax.random.key(i))
+        ))
+    get_global = lambda: base.view(np.uint8)  # noqa: E731
+    stream = CompressedDistAggregator(3, codec)
+    buf = CompressedBufferedDistAggregator(3, codec)
+    stream.get_global = buf.get_global = get_global
+    for i in (2, 0, 1):
+        stream.add_local_trained_result(i, encs[i], weights[i])
+        buf.add_local_trained_result(i, encs[i], weights[i])
+    out_s, out_b = stream.aggregate(), buf.aggregate()
+    np.testing.assert_array_equal(out_s, out_b)
+    assert not hasattr(stream, "model_dict")
+    with pytest.raises(EmptyRoundError):
+        CompressedDistAggregator(3, codec).aggregate()
+
+
+def test_duplicate_upload_first_wins_in_both():
+    flats, weights = _payloads(2)
+    dup = np.full(33, 9.0, np.float32).view(np.uint8)
+    outs = []
+    for cls in (FedAvgDistAggregator, BufferedFedAvgDistAggregator):
+        agg = cls(2)
+        agg.add_local_trained_result(0, flats[0], weights[0])
+        agg.add_local_trained_result(0, dup, 999.0)  # ignored
+        done = agg.add_local_trained_result(1, flats[1], weights[1])
+        assert done
+        outs.append(agg.aggregate())
+    np.testing.assert_array_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# send pool
+# ---------------------------------------------------------------------------
+
+
+def test_send_pool_per_destination_ordering():
+    pool = SendWorkerPool(workers=3, name="t-order")
+    try:
+        seen = []
+        lock = threading.Lock()
+
+        def task(i):
+            def run():
+                with lock:
+                    seen.append(i)
+            return run
+
+        pool.run_all([(7, task(i)) for i in range(50)])
+        assert seen == list(range(50))  # same destination: FIFO preserved
+    finally:
+        pool.close()
+
+
+def test_send_pool_overlaps_distinct_destinations():
+    pool = SendWorkerPool(workers=4, name="t-overlap")
+    try:
+        t0 = time.perf_counter()
+        pool.run_all([(dst, lambda: time.sleep(0.1)) for dst in range(4)])
+        elapsed = time.perf_counter() - t0
+        assert elapsed < 0.35, elapsed  # 4 x 0.1s sleeps overlapped
+    finally:
+        pool.close()
+
+
+def test_send_pool_error_propagation_and_shutdown():
+    pool = SendWorkerPool(workers=2, name="t-err")
+
+    def boom():
+        raise RuntimeError("send failed")
+
+    with pytest.raises(RuntimeError, match="send failed"):
+        pool.run_all([(0, boom), (1, lambda: None)])
+    pool.close()
+    pool.close()  # idempotent
+    for _ in range(50):
+        if pool.alive_workers == 0:
+            break
+        time.sleep(0.05)
+    assert pool.alive_workers == 0  # no thread leaks
+    assert not any(t.name.startswith("t-err") for t in threading.enumerate())
+    with pytest.raises(RuntimeError, match="closed"):
+        pool.run_all([(0, lambda: None)])
+
+
+def test_broadcast_send_spans_overlap_under_pool():
+    """Traced broadcast legs run concurrently on the pool: their comm/send
+    spans overlap in time (the acceptance signal for the send pool)."""
+    from fedml_tpu.obs import trace
+
+    class SlowFabric(LoopbackFabric):
+        def post_raw(self, receiver, data):
+            time.sleep(0.05)
+            super().post_raw(receiver, data)
+
+    fabric = SlowFabric(5)
+    mgr = LoopbackCommManager(fabric, 0, send_workers=4)
+    msg = Message(2, 0, 1)
+    msg.add_params("model_params", np.ones(64, np.float32))
+    tracer = trace.install()
+    try:
+        mgr.broadcast_message(msg, [1, 2, 3, 4])
+    finally:
+        trace.uninstall()
+    mgr.stop_receive_message()
+    sends = [e for e in tracer.events() if e["name"] == "comm/send"]
+    assert len(sends) == 4
+    assert all(e["args"]["broadcast"] == 1 for e in sends)
+    spans = sorted((e["ts"], e["ts"] + e["dur"]) for e in sends)
+    overlaps = sum(
+        1 for (s1, e1), (s2, _) in zip(spans, spans[1:]) if s2 < e1
+    )
+    assert overlaps >= 1, spans
+    # distinct pool-worker tracks carried the legs
+    assert len({e["tid"] for e in sends}) > 1
+
+
+def test_broadcast_is_read_only_under_tracing():
+    """Tracing must not perturb delivery: traced and untraced broadcasts
+    hand receivers identical bytes."""
+    from fedml_tpu.obs import trace
+
+    def deliver(traced):
+        fabric = LoopbackFabric(3)
+        mgr = LoopbackCommManager(fabric, 0)
+        msg = Message(2, 0, 1)
+        msg.add_params("model_params", np.arange(32, dtype=np.float32))
+        if traced:
+            trace.install()
+        try:
+            mgr.broadcast_message(msg, [1, 2],
+                                  per_receiver={1: {"client_idx": 4},
+                                                2: {"client_idx": 6}})
+        finally:
+            if traced:
+                trace.uninstall()
+        out = []
+        for r in (1, 2):
+            head, tail = fabric.queues[r].get_nowait()
+            out.append(bytes(head) + bytes(tail))
+        return out
+
+    assert deliver(False) == deliver(True)
+
+
+# ---------------------------------------------------------------------------
+# gRPC satellites
+# ---------------------------------------------------------------------------
+
+
+def test_grpc_receive_queue_is_deque_and_timeout_plumbed():
+    grpc = pytest.importorskip("grpc")
+    from collections import deque
+
+    from tests.test_comm import _free_port_run
+
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    base = _free_port_run(2)
+    cfg = {0: ("127.0.0.1", base), 1: ("127.0.0.1", base + 1)}
+    a = GRPCCommManager(0, cfg, send_timeout=33.0, send_workers=2)
+    b = GRPCCommManager(1, cfg, send_timeout=33.0, send_workers=0)
+    try:
+        assert isinstance(a._queue, deque) and isinstance(b._queue, deque)
+        assert a.send_timeout == 33.0
+        assert b._send_pool is None
+        got = []
+
+        class Obs:
+            def receive_message(self, t, m):
+                got.append((m.get_receiver_id(), np.asarray(m.get("w")).sum()))
+                if len(got) == 2:
+                    b.stop_receive_message()
+
+        b.add_observer(Obs())
+        th = threading.Thread(target=b.handle_receive_message, daemon=True)
+        th.start()
+        msg = Message(9, 0, 1)
+        msg.add_params("w", np.ones(16, np.float32))
+        a.broadcast_message(msg, [1, 1])  # two legs, same dst: FIFO on pool
+        th.join(timeout=20)
+        assert got == [(1, 16.0), (1, 16.0)]
+    finally:
+        a.stop_receive_message()
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def test_wire_smoke_tool_runs():
+    """tools/wire_smoke.py is the tier-1 guard the docs point at — run it
+    in-process (mirrors the pipeline/pack smokes' wiring)."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "wire_smoke.py"
+    spec = importlib.util.spec_from_file_location("wire_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

@@ -1,0 +1,136 @@
+"""Server wire-path smoke: 3 rounds of real message-passing FedAvg on the
+loopback fabric with the NEW wire path (encode-once broadcast downlink +
+streaming accumulate-on-arrival aggregation, the defaults) vs the LEGACY
+path (per-rank ``send_message`` loop + buffered retain-then-sum tally),
+asserting byte-identical global models every round and at the end — the
+cheap tier-1 guard for the encode-once/streaming contract
+(docs/PERFORMANCE.md "The server wire path").
+
+Upload arrival order is pinned by a rank-ordered uplink fabric (worker
+threads race otherwise, and f64 accumulation order matters in the last
+ULPs), so the bit-identity assertion is deterministic. The smoke also
+checks the encode-once ledger: the broadcast arm must serialize each model
+fan-out ONCE where the legacy arm pays once per rank.
+
+    JAX_PLATFORMS=cpu python tools/wire_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 3
+WORKERS = 4
+
+
+def main(argv=None) -> int:
+    import threading
+
+    import jax
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.comm.message import Message, reset_wire_stats, wire_stats
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    class RankOrderedUplinkFabric(LoopbackFabric):
+        """Holds each round's model uploads until every worker's arrived,
+        then posts them in sender order — pins the server's fold order so
+        both arms accumulate in the same sequence."""
+
+        def __init__(self, world_size: int, expected: int):
+            super().__init__(world_size)
+            self._expected = expected
+            self._held: dict[int, bytes] = {}
+            self._lock = threading.Lock()
+
+        def post(self, msg: Message) -> None:
+            if (msg.get_receiver_id() == 0
+                    and msg.get_type() == MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER):
+                with self._lock:
+                    self._held[msg.get_sender_id()] = msg.to_bytes()
+                    if len(self._held) < self._expected:
+                        return
+                    batch, self._held = sorted(self._held.items()), {}
+                for _, data in batch:
+                    self.post_raw(0, data)
+                return
+            super().post(msg)
+
+    train, _ = gaussian_blobs(
+        n_clients=WORKERS, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+
+    def run(server_kwargs):
+        fabric = RankOrderedUplinkFabric(WORKERS + 1, WORKERS)
+        per_round = []
+        reset_wire_stats()
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=WORKERS, round_num=ROUNDS,
+            batch_size=8,
+            make_comm=lambda r: LoopbackCommManager(fabric, r),
+            on_round_done=lambda r, v: per_round.append(
+                (r, [np.asarray(l).copy() for l in jax.tree.leaves(v)])
+            ),
+            server_kwargs=server_kwargs,
+        )
+        return final, per_round, wire_stats()
+
+    new_final, new_rounds, new_stats = run(
+        {"use_broadcast": True, "buffered_aggregation": False}
+    )
+    legacy_final, legacy_rounds, legacy_stats = run(
+        {"use_broadcast": False, "buffered_aggregation": True}
+    )
+
+    # bit-identity: every round's global model and the final variables
+    assert len(new_rounds) == len(legacy_rounds) == ROUNDS
+    for (rn, new_leaves), (rl, legacy_leaves) in zip(new_rounds, legacy_rounds):
+        assert rn == rl
+        for a, b in zip(new_leaves, legacy_leaves):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"round {rn}: broadcast+streaming != legacy"
+            )
+    for a, b in zip(jax.tree.leaves(new_final), jax.tree.leaves(legacy_final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # encode-once ledger: the protocol performs ROUNDS+1 downlink fan-outs
+    # (init + per-round sync/stop) and WORKERS uploads per round. Broadcast
+    # serializes each fan-out once; legacy once per rank.
+    uplinks = ROUNDS * WORKERS
+    fanouts = ROUNDS + 1
+    expect_new = fanouts + uplinks
+    expect_legacy = fanouts * WORKERS + uplinks
+    assert new_stats["payload_serializations"] == expect_new, (
+        new_stats, expect_new
+    )
+    assert legacy_stats["payload_serializations"] == expect_legacy, (
+        legacy_stats, expect_legacy
+    )
+
+    print(
+        f"wire smoke OK: {ROUNDS} rounds x {WORKERS} workers, "
+        "broadcast+streaming == per-rank+buffered bit-for-bit; "
+        f"payload serializations {new_stats['payload_serializations']} "
+        f"(encode-once) vs {legacy_stats['payload_serializations']} (legacy)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
