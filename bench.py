@@ -516,6 +516,53 @@ def bench_broadcast_ab(n_fanouts: int = 25):
     return out
 
 
+def bench_robust_ab(n_rounds: int = 4):
+    """Robust streaming vs plain streaming rounds/sec on the loopback
+    message-passing path (docs/ROBUSTNESS.md): arm A folds each upload
+    through the per-upload clip + seeded-DP defense
+    (robust_distributed.RobustDistAggregator), arm B is the plain streaming
+    tally — same workers, rounds, data, and arrival schedule. The defense
+    adds one O(model) delta/norm pass per upload, so the acceptance target
+    is robust within ~10% of plain. Returns probe metrics."""
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg_loopback
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    workers = 4
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=64,
+                              num_classes=4, seed=0)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    defense = RobustDistConfig(rule="mean", norm_bound=0.5, dp_stddev=0.01)
+
+    def run(robust_config):
+        run_distributed_fedavg_loopback(  # warm (compile + thread spinup)
+            trainer, train, worker_num=workers, round_num=1, batch_size=16,
+            robust_config=robust_config,
+        )
+        t0 = time.perf_counter()
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=workers, round_num=n_rounds,
+            batch_size=16, robust_config=robust_config,
+        )
+        return n_rounds / (time.perf_counter() - t0)
+
+    plain_rps, robust_rps = run(None), run(defense)
+    return {
+        "robust_rounds_per_sec": round(robust_rps, 2),
+        "robust_plain_rounds_per_sec": round(plain_rps, 2),
+        "robust_overhead_frac": round(1.0 - robust_rps / plain_rps, 4),
+        "robust_workers": workers,
+    }
+
+
 def bench_resnet(reduced: bool = False):
     """(rounds/sec, eval examples/sec, pipeline extras) for the primary
     ResNet-56 config.
@@ -886,6 +933,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_broadcast_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["broadcast_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_robust_probe"
+    try:
+        pipeline_extra.update(bench_robust_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["robust_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_stage_probe"
     try:

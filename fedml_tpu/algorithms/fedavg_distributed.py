@@ -296,6 +296,11 @@ class FedAvgServerManager(ServerManager):
                 msg = Message(msg_type, 0, group[0])
                 msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
                                payloads[group[0]])
+                # the authoritative round index rides every sync: clients
+                # train AS this round instead of counting received syncs,
+                # so a duplicated/replayed downlink leg (comm/faults.py dup)
+                # cannot desynchronize a client's round counter forever
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
                 if include_desc:
                     msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                    self.model_desc)
@@ -307,6 +312,8 @@ class FedAvgServerManager(ServerManager):
                     msg = Message(msg_type, 0, w)
                     msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
                                    payloads[w])
+                    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
+                                   self.round_idx)
                     if include_desc:
                         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                        self.model_desc)
@@ -483,6 +490,14 @@ class FedAvgClientManager(ClientManager):
         if msg.get("finished"):
             self.finish()
             return
+        ridx = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if ridx is not None:
+            # train AS the server's round, not as "however many syncs this
+            # client has seen": a duplicated or delayed downlink leg then
+            # re-trains the same round (its duplicate upload is absorbed by
+            # the tally's first-wins rule) instead of desynchronizing the
+            # round counter for the rest of the run
+            self._round = int(ridx)
         variables = self._decode_model(msg)
         client_idx = int(msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX))
         self._client_idx = client_idx  # which client this round trains as
@@ -699,6 +714,10 @@ def run_distributed_fedavg(
     codec=None,
     error_feedback: bool = True,
     comm_stats: dict | None = None,
+    robust_config=None,
+    robust_stats: dict | None = None,
+    fault_specs=None,
+    fault_seed: int = 0,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -710,18 +729,47 @@ def run_distributed_fedavg(
     duplicating this harness. ``codec`` switches the uplink to the
     compressed-update protocol (compress/codec.py; ``error_feedback``
     toggles per-worker residual carryover, ``comm_stats`` — a caller dict —
-    receives per-round and total bytes-on-wire records). Returns the final
-    global variables."""
+    receives per-round and total bytes-on-wire records). ``robust_config``
+    (a robust_distributed.RobustDistConfig) swaps the server tally for the
+    streaming Byzantine-robust + DP one, composing with ``codec``
+    (``robust_stats`` receives per-round Robust/* records).
+    ``fault_specs`` (comm/faults.py: a {rank: FaultSpec} map or a spec
+    string) wraps every rank's transport in the seeded fault injector.
+    Returns the final global variables."""
     if codec is not None and (server_cls is not None
                               or client_cls_for_rank is not None):
         raise ValueError(
             "codec= does not compose with custom manager classes "
             "(e.g. is_mobile's JSON wire format)"
         )
+    if robust_config is not None and not robust_config.enabled:
+        robust_config = None  # a no-op defense is exactly plain FedAvg
+    if robust_config is not None and (server_cls is not None
+                                      or client_cls_for_rank is not None):
+        raise ValueError(
+            "robust_config= does not compose with custom manager classes "
+            "(e.g. is_mobile's JSON wire format)"
+        )
+    if fault_specs is not None:
+        from fedml_tpu.comm.faults import wrap_make_comm
+
+        make_comm = wrap_make_comm(make_comm, fault_specs, seed=fault_seed)
     template, flat, desc = init_template(trainer, train_data.arrays, batch_size,
                                          seed, init_overrides=init_overrides)
+    if robust_config is not None:
+        from fedml_tpu.algorithms.robust_distributed import (
+            RobustCompressedFedAvgServerManager,
+            RobustFedAvgServerManager,
+        )
+
+        server_cls = (RobustCompressedFedAvgServerManager if codec is not None
+                      else RobustFedAvgServerManager)
+        server_kwargs = {**(server_kwargs or {}),
+                         "robust_config": robust_config,
+                         "robust_stats": robust_stats}
     if codec is not None:
-        server_cls = CompressedFedAvgServerManager
+        if server_cls is None:
+            server_cls = CompressedFedAvgServerManager
         server_kwargs = {**(server_kwargs or {}), "codec": codec}
 
         def client_cls_for_rank(rank):

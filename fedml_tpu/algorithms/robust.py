@@ -16,7 +16,10 @@ stacked representation.
 from __future__ import annotations
 
 import dataclasses
+import json
 from typing import Any
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -33,9 +36,19 @@ def _is_norm_stat(path: str) -> bool:
     return "batch_stats" in path
 
 
-def clip_deltas(global_params: Pytree, stacked: Pytree, norm_bound: float) -> Pytree:
-    """Norm-difference clipping (robust_aggregation.py:38-49): scale each
-    client's delta so its L2 norm (over non-BN leaves) is <= norm_bound."""
+def clip_scale(norms, norm_bound: float):
+    """THE norm-difference clip factor (robust_aggregation.py:38-49):
+    ``min(1, bound / max(norm, 1e-12))``. Single source of the clip
+    arithmetic — shared by the sim engine's stacked :func:`clip_deltas`
+    and the wire path's per-upload streaming clip
+    (algorithms/robust_distributed.py), so both defenses are one
+    definition. Accepts jnp tracers and np scalars alike."""
+    return jnp.minimum(1.0, norm_bound / jnp.maximum(norms, 1e-12))
+
+
+def delta_norms(global_params: Pytree, stacked: Pytree) -> tuple[Pytree, jnp.ndarray]:
+    """Per-client deltas and their L2 norms over non-BN leaves. Returns
+    (deltas with leaves [C, ...], norms [C])."""
 
     def _client_norm(client_tree):
         vec = treelib.tree_vectorize(client_tree, exclude=_is_norm_stat)
@@ -45,13 +58,85 @@ def clip_deltas(global_params: Pytree, stacked: Pytree, norm_bound: float) -> Py
     norms = jax.vmap(lambda i: _client_norm(jax.tree.map(lambda d: d[i], deltas)))(
         jnp.arange(jax.tree_util.tree_leaves(stacked)[0].shape[0])
     )
-    scale = jnp.minimum(1.0, norm_bound / jnp.maximum(norms, 1e-12))  # [C]
+    return deltas, norms
+
+
+def clip_deltas(global_params: Pytree, stacked: Pytree, norm_bound: float) -> Pytree:
+    """Norm-difference clipping (robust_aggregation.py:38-49): scale each
+    client's delta so its L2 norm (over non-BN leaves) is <= norm_bound."""
+    deltas, norms = delta_norms(global_params, stacked)
+    scale = clip_scale(norms, norm_bound)  # [C]
 
     def _apply(d_leaf, g_leaf):
         sb = scale.reshape((-1,) + (1,) * (d_leaf.ndim - 1))
         return g_leaf[None] + d_leaf * sb
 
     return jax.tree.map(_apply, deltas, global_params)
+
+
+# --- flat-vector (wire payload) defense helpers ------------------------------
+# The message-passing server folds pack_pytree byte vectors (all-f32 leaves,
+# validated at server init) — these helpers apply the SAME defense statistics
+# to that layout so the sim and distributed paths share one definition of
+# "what gets clipped and over which coordinates".
+
+
+def flat_norm_mask(model_desc: str) -> np.ndarray | None:
+    """Elementwise bool mask over the ``pack_pytree`` f32 wire layout:
+    False on BatchNorm-statistics leaves (:func:`_is_norm_stat`), which the
+    robust statistics exclude. Returns None when nothing is excluded (the
+    common no-BN case — callers skip the masked gather entirely)."""
+    desc = json.loads(model_desc)
+    if not any(_is_norm_stat(d["path"]) for d in desc):
+        return None
+    parts = [
+        np.full(int(np.prod(d["shape"])) if d["shape"] else 1,
+                not _is_norm_stat(d["path"]))
+        for d in desc
+    ]
+    return np.concatenate(parts)
+
+
+def flat_delta_norm(delta: np.ndarray, mask: np.ndarray | None) -> float:
+    """L2 norm of a flat f32 delta vector over non-excluded coordinates —
+    the wire-path counterpart of :func:`delta_norms` (f32 accumulation,
+    matching the sim's ``jnp.linalg.norm`` over f32)."""
+    v = delta if mask is None else delta[mask]
+    return float(np.linalg.norm(v))
+
+
+def add_cli_flags(parser):
+    """Register the canonical robust-defense flags on a repro entry point
+    (one help text everywhere; mirrors obs.trace.add_cli_flag). The flags
+    map 1:1 onto the SimConfig robust fields via
+    :func:`sim_config_fields`."""
+    parser.add_argument("--robust_rule", type=str, default="mean",
+                        choices=list(RobustConfig.RULES),
+                        help="robust combine rule over the cohort stack "
+                             "(docs/ROBUSTNESS.md); 'mean' is plain FedAvg")
+    parser.add_argument("--norm_bound", type=float, default=0.0,
+                        help="clip each client delta's L2 norm to this "
+                             "bound (0 = no clipping)")
+    parser.add_argument("--dp_stddev", type=float, default=0.0,
+                        help="seeded weak-DP gaussian noise stddev on the "
+                             "aggregate (0 = no noise)")
+    return parser
+
+
+def sim_config_fields(args) -> dict:
+    """The SimConfig kwargs for :func:`add_cli_flags`'s values."""
+    return {
+        "robust_rule": args.robust_rule,
+        "norm_bound": args.norm_bound,
+        "dp_stddev": args.dp_stddev,
+    }
+
+
+def dp_noise_key(seed: int, round_idx: int) -> jax.Array:
+    """Round-indexed DP noise key: ``fold_in(key(seed), round)`` — the
+    seeded schedule the wire path's streaming and buffered arms share, so
+    clipped+DP runs are bit-reproducible (and bit-identical across arms)."""
+    return jax.random.fold_in(jax.random.key(seed), round_idx)
 
 
 def add_weak_dp_noise(tree: Pytree, stddev: float, rng: jax.Array) -> Pytree:
@@ -74,15 +159,28 @@ def coordinate_median(stacked: Pytree) -> Pytree:
     return jax.tree.map(lambda s: jnp.median(s, axis=0).astype(s.dtype), stacked)
 
 
+def trimmed_ratio_k(c: int, trim_ratio: float) -> int:
+    """Per-side trim count ``k = int(trim_ratio * C)``, validated: a config
+    where ``C - 2k <= 0`` would trim away every client — the old code
+    silently fell back to a plain mean, masking the misconfiguration."""
+    k = int(trim_ratio * c)
+    if c - 2 * k <= 0:
+        raise ValueError(
+            f"trimmed_mean: trim_ratio={trim_ratio} with C={c} clients trims "
+            f"k={k} per side, leaving C - 2k = {c - 2 * k} <= 0 updates — "
+            "nothing to average; lower trim_ratio (or grow the cohort)"
+        )
+    return k
+
+
 def trimmed_mean(stacked: Pytree, trim_ratio: float = 0.1) -> Pytree:
     """Coordinate-wise trimmed mean: drop the k highest/lowest per coordinate."""
+    c = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+    k = trimmed_ratio_k(c, trim_ratio)
 
     def _tm(s):
-        c = s.shape[0]
-        k = int(trim_ratio * c)
         srt = jnp.sort(s, axis=0)
-        kept = srt[k : c - k] if c - 2 * k > 0 else srt
-        return jnp.mean(kept, axis=0).astype(s.dtype)
+        return jnp.mean(srt[k : c - k], axis=0).astype(s.dtype)
 
     return jax.tree.map(_tm, stacked)
 
@@ -96,7 +194,14 @@ def krum_select(stacked: Pytree, num_byzantine: int = 1) -> jnp.ndarray:
     d2 = jnp.sum((mat[:, None, :] - mat[None, :, :]) ** 2, axis=-1)  # [C, C]
     C = mat.shape[0]
     closest = C - num_byzantine - 2
-    closest = max(closest, 1)
+    if closest < 1:
+        # the old code silently clamped to 1, i.e. quietly ran a different
+        # (much weaker) selection rule than the one configured
+        raise ValueError(
+            f"krum_select: num_byzantine={num_byzantine} with C={C} clients "
+            f"leaves C - f - 2 = {closest} < 1 neighbors to score — Krum "
+            f"needs num_byzantine <= C - 3 (here <= {C - 3})"
+        )
     d2 = d2 + jnp.eye(C) * jnp.inf  # exclude self
     scores = jnp.sum(jnp.sort(d2, axis=1)[:, :closest], axis=1)
     return jnp.argmin(scores)
@@ -112,17 +217,64 @@ class RobustConfig:
     trim_ratio: float = 0.1
     num_byzantine: int = 1
 
+    RULES = ("mean", "median", "trimmed_mean", "krum")
+
+    def __post_init__(self):
+        if self.rule not in self.RULES:
+            raise ValueError(
+                f"unknown robust rule {self.rule!r} (expected one of "
+                f"{self.RULES}) — a silent mean fallback would run no "
+                "defense at all"
+            )
+
+    @property
+    def enabled(self) -> bool:
+        """True when any defense stage is active (a disabled config is
+        exactly plain FedAvg)."""
+        return self.norm_bound > 0 or self.stddev > 0 or self.rule != "mean"
+
 
 def robust_aggregator(config: RobustConfig) -> Aggregator:
     """Clip → combine (mean/median/trimmed/krum) → noise, the reference
-    pipeline (FedAvgRobustAggregator.py:176-206) as one jitted function."""
+    pipeline (FedAvgRobustAggregator.py:176-206) as one jitted function.
+
+    Round metrics gain the Robust/* keys (obs/metrics.py): mean pre-clip
+    delta norm, clipped fraction, and rule-filtered client count — all over
+    the real (weight > 0) cohort, excluding padding slots."""
+    from fedml_tpu.obs import metrics as metricslib
 
     def init_state(global_variables):
         return ()
 
     def aggregate(global_variables, stacked, weights, state, rng, extras=None):
+        c = jax.tree_util.tree_leaves(stacked)[0].shape[0]
+        real = (weights > 0).astype(jnp.float32)  # padding slots excluded
+        n_real = jnp.maximum(jnp.sum(real), 1.0)
+        deltas, norms = delta_norms(global_variables, stacked)
+        # updates the combine rule discards, counted over REAL clients
+        # (median/krum keep one representative; trimmed mean drops k per
+        # side of the executed — possibly padded — stack)
+        if config.rule in ("median", "krum"):
+            filtered = n_real - 1.0
+        elif config.rule == "trimmed_mean":
+            filtered = jnp.float32(2 * trimmed_ratio_k(c, config.trim_ratio))
+        else:
+            filtered = jnp.float32(0.0)
+        metrics = {
+            metricslib.ROBUST_UPDATE_NORM: jnp.sum(norms * real) / n_real,
+            metricslib.ROBUST_FILTERED: jnp.float32(filtered),
+        }
         if config.norm_bound > 0:
-            stacked = clip_deltas(global_variables, stacked, config.norm_bound)
+            scale = clip_scale(norms, config.norm_bound)  # [C]
+            metrics[metricslib.ROBUST_CLIP_FRACTION] = (
+                jnp.sum((scale < 1.0).astype(jnp.float32) * real) / n_real
+            )
+
+            def _apply(d_leaf, g_leaf):
+                sb = scale.reshape((-1,) + (1,) * (d_leaf.ndim - 1))
+                return g_leaf[None] + d_leaf * sb
+
+            stacked = jax.tree.map(_apply, deltas, global_variables)
         if config.rule == "median":
             out = coordinate_median(stacked)
         elif config.rule == "trimmed_mean":
@@ -134,6 +286,6 @@ def robust_aggregator(config: RobustConfig) -> Aggregator:
             out = treelib.tree_weighted_mean(stacked, weights)
         if config.stddev > 0:
             out = add_weak_dp_noise(out, config.stddev, rng)
-        return out, state, {}
+        return out, state, metrics
 
     return Aggregator(init_state, aggregate, name=f"robust-{config.rule}")

@@ -1,0 +1,502 @@
+"""Streaming Byzantine-robust + DP aggregation for the message-passing wire
+path (docs/ROBUSTNESS.md).
+
+The sim engine's ``robust_aggregator`` (algorithms/robust.py) defends over a
+stacked [C, ...] cohort — exactly the per-client buffering the streaming
+server (PR 5, docs/PERFORMANCE.md "The server wire path") removed from the
+hot path. This module folds the same defense pipeline into the
+accumulate-on-arrival tally without giving back the O(model) memory win:
+
+- **clip** — each upload's delta against the last broadcast global model is
+  norm-clipped AT ARRIVAL (``robust.clip_scale``, the same factor definition
+  the sim uses; BN statistics excluded via ``robust.flat_norm_mask``), and
+  the clipped update folds straight into the running f64 accumulator.
+  Non-finite uploads (a bit-corrupted wire payload decodes to inf/NaN) are
+  rejected outright — their weight never enters the divisor.
+- **combine** — the ``mean`` rule stays pure streaming. Median / trimmed
+  mean / Krum are cross-client order statistics that fundamentally need a
+  stack, so they get a bounded-memory arm: a seeded reservoir of K clipped
+  uploads (K ≪ N, ``reservoir_k``; 0 keeps every upload = the exact rule).
+  At round close the reservoir stack runs through the SAME rule functions
+  as the sim (``coordinate_median`` / ``trimmed_mean`` / ``krum_select``).
+- **noise** — seeded weak-DP gaussian noise on the aggregate at round close
+  (``robust.add_weak_dp_noise`` with the ``dp_noise_key`` round schedule),
+  so a clipped+DP run is bit-reproducible.
+
+``Buffered*`` variants retain every upload and replay the identical
+defended fold in arrival order at round close — the bit-exactness oracle
+for the streaming arm (tools/robust_smoke.py + tests/test_robust.py hold
+streaming == buffered byte-for-byte, elastic-timeout drops included).
+``RobustCompressedDistAggregator`` composes with the encoded-update uplink:
+the decoded fold is lifted to the model domain and clipped exactly like a
+dense upload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    BufferedFedAvgDistAggregator,
+    CompressedFedAvgClientManager,
+    CompressedFedAvgServerManager,
+    FedAvgDistAggregator,
+    FedAvgServerManager,
+)
+from fedml_tpu.algorithms.robust import (
+    add_weak_dp_noise,
+    clip_scale,
+    coordinate_median,
+    dp_noise_key,
+    flat_delta_norm,
+    flat_norm_mask,
+    krum_select,
+    trimmed_mean,
+)
+from fedml_tpu.obs import metrics as metricslib
+from fedml_tpu.obs import trace
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustDistConfig:
+    """Wire-path defense pipeline knobs (the distributed counterpart of
+    robust.RobustConfig, plus the streaming-specific reservoir bound and
+    noise seed)."""
+
+    rule: str = "mean"  # mean | median | trimmed_mean | krum
+    norm_bound: float = 0.0  # >0 enables per-upload clipping
+    dp_stddev: float = 0.0  # >0 enables seeded weak-DP noise at close
+    dp_seed: int = 0  # seeds the noise schedule AND the reservoir rng
+    reservoir_k: int = 0  # non-mean rules: keep K uploads (0 = all = exact)
+    trim_ratio: float = 0.1
+    num_byzantine: int = 1
+
+    def __post_init__(self):
+        from fedml_tpu.algorithms.robust import RobustConfig
+
+        if self.rule not in RobustConfig.RULES:
+            raise ValueError(
+                f"unknown robust rule {self.rule!r} (expected one of "
+                f"{RobustConfig.RULES})"
+            )
+        if self.reservoir_k < 0:
+            raise ValueError(f"reservoir_k must be >= 0, got {self.reservoir_k}")
+
+    @property
+    def enabled(self) -> bool:
+        return self.norm_bound > 0 or self.dp_stddev > 0 or self.rule != "mean"
+
+
+def _reservoir_rng(config: RobustDistConfig, round_idx: int) -> np.random.RandomState:
+    """Per-round seeded reservoir sampler: draws depend only on (seed,
+    round, arrival order), so the buffered oracle's arrival-order replay
+    reproduces the streaming arm's reservoir exactly."""
+    return np.random.RandomState(
+        (config.dp_seed * 1_000_003 + round_idx * 7919 + 0x0B57) % (2**31)
+    )
+
+
+class RobustDistAggregator(FedAvgDistAggregator):
+    """Streaming tally with the defense folded into the arrival path.
+
+    Memory: O(model) for the accumulator plus O(reservoir_k x model) for
+    non-mean rules — never O(workers x model). ``get_global`` (wired by the
+    server manager) supplies the last broadcast flat model, the clip
+    reference."""
+
+    def __init__(self, worker_num: int, config: RobustDistConfig,
+                 model_desc: str | None = None):
+        super().__init__(worker_num)
+        self.config = config
+        self.get_global = None  # wired by the server manager (current flat)
+        self._norm_mask = flat_norm_mask(model_desc) if model_desc else None
+        self._round_counter = 0
+        self._reservoir: list[np.ndarray] = []
+        self._res_seen = 0
+        self._res_rng = _reservoir_rng(config, 0)
+        self._stats = {"norm_sum": 0.0, "n": 0, "clipped": 0, "rejected": 0}
+        self._last_record: dict | None = None
+
+    # -- defended arrival fold ----------------------------------------------
+
+    def _fold(self, payload, sample_num: float) -> None:
+        x = np.ascontiguousarray(payload).view(np.float32)
+        self._defended_fold(x, sample_num)
+
+    def _defended_fold(self, x: np.ndarray, sample_num: float) -> None:
+        """Clip ``x`` (a flat f32 model vector) against the last broadcast
+        global and fold it — into the f64 accumulator (mean rule) and/or the
+        reservoir (order-statistic rules). Caller holds the tally lock."""
+        cfg = self.config
+        with trace.span("robust/fold", rule=cfg.rule):
+            self._stats["n"] += 1
+            base = np.ascontiguousarray(self.get_global()).view(np.float32)
+            delta = x - base
+            with trace.span("robust/clip"):
+                # finiteness is checked on the FULL delta norm (BN-stat
+                # coordinates included — a corrupted coordinate anywhere
+                # would poison the accumulator), and runs for every defense
+                # config, DP-noise-only included; the clip norm then
+                # excludes BN statistics like the sim's
+                full_norm = float(np.linalg.norm(delta))
+                if not np.isfinite(full_norm):
+                    self._stats["rejected"] += 1
+                    return
+                norm = (full_norm if self._norm_mask is None
+                        else flat_delta_norm(delta, self._norm_mask))
+                self._stats["norm_sum"] += norm
+                if cfg.norm_bound > 0:
+                    scale = float(clip_scale(jnp.float32(norm),
+                                             cfg.norm_bound))
+                    if scale < 1.0:
+                        self._stats["clipped"] += 1
+                        x = base + delta * np.float32(scale)
+            if cfg.rule == "mean":
+                super()._fold(x, sample_num)
+            else:
+                self._reservoir_add(x)
+
+    def _reservoir_add(self, x: np.ndarray) -> None:
+        """Algorithm-R reservoir over the round's (clipped) uploads: every
+        upload has equal probability K/seen of being in the close-time
+        stack. ``reservoir_k == 0`` keeps everything (the exact rule)."""
+        k = self.config.reservoir_k
+        self._res_seen += 1
+        if k == 0 or len(self._reservoir) < k:
+            self._reservoir.append(np.array(x, np.float32))  # own the bytes
+        else:
+            j = int(self._res_rng.randint(self._res_seen))
+            if j < k:
+                self._reservoir[j] = np.array(x, np.float32)
+
+    # -- round close ---------------------------------------------------------
+
+    def _finish(self) -> np.ndarray:
+        cfg = self.config
+        with trace.span("robust/close", rule=cfg.rule):
+            all_rejected = (self._acc is None if cfg.rule == "mean"
+                            else not self._reservoir)
+            if all_rejected:
+                # every upload this round was rejected as non-finite: the
+                # defense discards the whole round and keeps the previous
+                # global (no noise either — the model must not drift on an
+                # all-hostile round)
+                logging.warning(
+                    "robust round close: every upload rejected (non-finite); "
+                    "keeping the previous global model"
+                )
+                out = np.array(
+                    np.ascontiguousarray(self.get_global()).view(np.float32)
+                )
+                rule_filtered = 0
+                self._acc = None
+                self._wsum = 0.0
+                self._reservoir = []
+                self._res_seen = 0
+            elif cfg.rule == "mean":
+                out = (self._acc / self._wsum).astype(np.float32)
+                self._acc = None
+                self._wsum = 0.0
+                rule_filtered = 0
+            else:
+                stack = np.stack(self._reservoir)  # [K, D] f32
+                out, rule_filtered = self._combine_reservoir(stack)
+                self._reservoir = []
+                self._res_seen = 0
+                self._acc = None
+                self._wsum = 0.0
+            if cfg.dp_stddev > 0 and not all_rejected:
+                key = dp_noise_key(cfg.dp_seed, self._round_counter)
+                out = np.asarray(add_weak_dp_noise(
+                    {"w": jnp.asarray(out)}, cfg.dp_stddev, key
+                )["w"], np.float32)
+            self._round_counter += 1
+            self._res_rng = _reservoir_rng(cfg, self._round_counter)
+            s, self._stats = self._stats, {
+                "norm_sum": 0.0, "n": 0, "clipped": 0, "rejected": 0
+            }
+            # clip statistics average over the uploads that actually folded
+            # (rejected non-finite uploads contributed no norm), matching
+            # the sim path's real-client denominator
+            folded = max(s["n"] - s["rejected"], 1)
+            self._last_record = {
+                metricslib.ROBUST_UPDATE_NORM: s["norm_sum"] / folded,
+                metricslib.ROBUST_CLIP_FRACTION: s["clipped"] / folded,
+                metricslib.ROBUST_FILTERED: s["rejected"] + rule_filtered,
+            }
+            return out.astype(np.float32).view(np.uint8)
+
+    def _combine_reservoir(self, stack: np.ndarray) -> tuple[np.ndarray, int]:
+        """Run the sim's rule functions — the single source of the combine
+        arithmetic — over the reservoir stack. Returns (aggregate, number of
+        updates the rule discarded).
+
+        An elastic-timeout round can close with fewer survivors than the
+        configured rule supports (trimmed_mean with ``C - 2k <= 0``, krum
+        with ``num_byzantine > C - 3``); raising here would kill the round
+        close on the server's timer/handler thread and wedge the protocol,
+        so the close degrades to the coordinate median for THAT round — the
+        strictest rule defined for any survivor count — with a warning.
+        The same survivor count produces the same fallback in both arms, so
+        streaming == buffered is unaffected."""
+        cfg, k = self.config, len(stack)
+        rule = cfg.rule
+        if rule == "trimmed_mean" and k - 2 * int(cfg.trim_ratio * k) <= 0:
+            logging.warning(
+                "robust close: %d survivors cannot support trimmed_mean"
+                "(trim_ratio=%s); using the coordinate median this round",
+                k, cfg.trim_ratio,
+            )
+            rule = "median"
+        if rule == "krum" and cfg.num_byzantine > k - 3:
+            logging.warning(
+                "robust close: %d survivors cannot support krum"
+                "(num_byzantine=%d); using the coordinate median this round",
+                k, cfg.num_byzantine,
+            )
+            rule = "median"
+        if rule == "median":
+            out = np.asarray(
+                coordinate_median({"w": jnp.asarray(stack)})["w"], np.float32
+            )
+            return out, k - 1
+        if rule == "trimmed_mean":
+            out = np.asarray(
+                trimmed_mean({"w": jnp.asarray(stack)}, cfg.trim_ratio)["w"],
+                np.float32,
+            )
+            return out, 2 * int(cfg.trim_ratio * k)
+        # krum: score distances over non-BN coordinates, return the winner
+        kstack = stack if self._norm_mask is None else stack[:, self._norm_mask]
+        idx = int(krum_select({"w": jnp.asarray(kstack)}, cfg.num_byzantine))
+        return stack[idx], k - 1
+
+    def pop_round_stats(self) -> dict | None:
+        """The closed round's Robust/* record (None when no round closed
+        since the last pop) — the server manager flushes it into the
+        metrics stream."""
+        with self._lock:
+            rec, self._last_record = self._last_record, None
+            return rec
+
+
+class BufferedRobustDistAggregator(BufferedFedAvgDistAggregator,
+                                   RobustDistAggregator):
+    """Bit-exactness oracle: retains every upload and replays the SAME
+    defended fold in arrival order at round close (same clip reference —
+    the global is only replaced after ``aggregate()`` — same reservoir
+    draws, same noise key), so streaming == buffered byte-for-byte under
+    any schedule, dropped stragglers included."""
+
+    def __init__(self, worker_num: int, config: RobustDistConfig,
+                 model_desc: str | None = None):
+        RobustDistAggregator.__init__(self, worker_num, config, model_desc)
+        self.model_dict = {}
+
+
+class RobustCompressedDistAggregator(RobustDistAggregator):
+    """Robust streaming tally for encoded uploads: decode the client's
+    EncodedUpdate to ONE transient dense vector, lift delta-domain codecs
+    onto the current global, then clip-and-fold exactly like a dense
+    upload ("clip the decoded fold"). Still O(model) host memory — one
+    transient decode at a time, never per-worker retention."""
+
+    def __init__(self, worker_num: int, config: RobustDistConfig, codec,
+                 model_desc: str | None = None):
+        super().__init__(worker_num, config, model_desc)
+        self.codec = codec
+
+    def _fold(self, payload, sample_num: float) -> None:
+        from fedml_tpu.compress.aggregate import _flat_leaves
+
+        try:
+            with trace.span("compress/decode", scheme=payload.scheme):
+                leaves = _flat_leaves(self.codec.decode(payload))
+                dense = np.concatenate([l.astype(np.float32) for l in leaves])
+        except Exception as e:
+            # a bit-corrupted encoded payload can be structurally
+            # undecodable (e.g. flipped top-k indices out of range) — for
+            # the robust tally that is just another hostile upload: reject
+            # it instead of killing the server's receive thread
+            logging.warning("robust fold: undecodable encoded upload "
+                            "rejected (%s: %s)", type(e).__name__, e)
+            self._stats["n"] += 1
+            self._stats["rejected"] += 1
+            return
+        if self.codec.delta_domain:
+            base = np.ascontiguousarray(self.get_global()).view(np.float32)
+            x = base + dense
+        else:
+            x = dense
+        self._defended_fold(np.asarray(x, np.float32), sample_num)
+
+
+class BufferedRobustCompressedDistAggregator(BufferedFedAvgDistAggregator,
+                                             RobustCompressedDistAggregator):
+    """Arrival-order replay oracle for the robust compressed tally."""
+
+    def __init__(self, worker_num: int, config: RobustDistConfig, codec,
+                 model_desc: str | None = None):
+        RobustCompressedDistAggregator.__init__(
+            self, worker_num, config, codec, model_desc
+        )
+        self.model_dict = {}
+
+
+class _RobustServerMixin:
+    """Shared server-manager wiring: swap in the robust tally and flush its
+    Robust/* record per closed round (mirrors comm_stats)."""
+
+    def _init_robust(self, robust_config: RobustDistConfig | None,
+                     robust_stats: dict | None) -> None:
+        if robust_config is None:
+            raise ValueError(f"{type(self).__name__} needs a robust_config")
+        self.robust_config = robust_config
+        self._robust_stats = robust_stats
+        self.aggregator.get_global = lambda: self.global_flat
+        # flush the closed round's Robust/* record BEFORE the caller's
+        # round callback fires (same ordering contract as the compressed
+        # server's comm_stats flush): a callback merging per-round metrics
+        # by round index must find round r already recorded
+        inner_cb = self.on_round_done
+
+        def _flush_then(round_idx: int, flat) -> None:
+            rec = self.aggregator.pop_round_stats()
+            if rec is not None:
+                rec = {"round": round_idx, **rec}
+                logging.info("robust defense: %s", rec)
+                if self._robust_stats is not None:
+                    self._robust_stats.setdefault("rounds", []).append(rec)
+            if inner_cb is not None:
+                inner_cb(round_idx, flat)
+
+        self.on_round_done = _flush_then
+
+
+class RobustFedAvgServerManager(_RobustServerMixin, FedAvgServerManager):
+    """FedAvg server with the streaming robust tally (dense uplink)."""
+
+    def __init__(self, *args, robust_config: RobustDistConfig | None = None,
+                 robust_stats: dict | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.aggregator = (
+            BufferedRobustDistAggregator if self.buffered_aggregation
+            else RobustDistAggregator
+        )(self.worker_num, robust_config, model_desc=self.model_desc)
+        self._init_robust(robust_config, robust_stats)
+
+
+class RobustCompressedFedAvgServerManager(_RobustServerMixin,
+                                          CompressedFedAvgServerManager):
+    """FedAvg server composing the encoded-update uplink with the robust
+    tally: decode → clip → fold, bytes-on-wire accounting unchanged."""
+
+    def __init__(self, *args, robust_config: RobustDistConfig | None = None,
+                 robust_stats: dict | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.aggregator = (
+            BufferedRobustCompressedDistAggregator if self.buffered_aggregation
+            else RobustCompressedDistAggregator
+        )(self.worker_num, robust_config, self.codec,
+          model_desc=self.model_desc)
+        self._init_robust(robust_config, robust_stats)
+
+
+# ---------------------------------------------------------------------------
+# Loopback attack simulation: poison -> distributed rounds -> ASR
+# ---------------------------------------------------------------------------
+
+
+def eval_accuracy(trainer, variables, arrays: dict, batch_size: int = 64) -> float:
+    """Pooled accuracy of ``variables`` on ``arrays`` ({"x","y"}) — used for
+    clean accuracy and, on a triggered test set (data/poison.py
+    ``backdoor_test_arrays``), the attack success rate."""
+    import jax
+
+    from fedml_tpu.sim.cohort import batch_array
+
+    batches = batch_array(arrays, batch_size)
+    correct = total = 0.0
+    for i in range(len(next(iter(batches.values())))):
+        b = {k: jnp.asarray(v[i]) for k, v in batches.items()}
+        m = trainer.eval_batch(variables, b)
+        correct += float(m["test_correct"])
+        total += float(m["test_total"])
+    return correct / max(total, 1.0)
+
+
+def run_attack_simulation(
+    trainer,
+    train_data,
+    test_arrays: dict,
+    worker_num: int,
+    round_num: int,
+    batch_size: int,
+    defense: RobustDistConfig,
+    compromised_frac: float = 0.5,
+    sample_frac: float = 1.0,
+    target_label: int = 0,
+    trigger=None,
+    poison_seed: int = 0,
+    fault_specs=None,
+    buffered_aggregation: bool = False,
+    round_timeout: float | None = None,
+    seed: int = 0,
+) -> dict:
+    """End-to-end loopback attack/defense A-B: poison a client fraction
+    (data/poison.py), run the real message-passing FedAvg protocol with the
+    defense ON and OFF (optionally through the fault-injection wrapper,
+    comm/faults.py), and report the backdoor attack success rate plus clean
+    accuracy for both arms. The reference's main_fedavg_robust attack loop,
+    driven over the wire path instead of buffered stacks."""
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg_loopback
+    from fedml_tpu.data.poison import Trigger, backdoor_test_arrays, poison_clients
+
+    trigger = trigger or Trigger()
+    poisoned, bad, counts = poison_clients(
+        train_data, compromised_frac=compromised_frac, sample_frac=sample_frac,
+        target_label=target_label, trigger=trigger, seed=poison_seed,
+    )
+    backdoor = backdoor_test_arrays(test_arrays, target_label=target_label,
+                                    trigger=trigger)
+
+    def arm(robust_config):
+        stats: dict = {}
+        final = run_distributed_fedavg_loopback(
+            trainer, poisoned, worker_num=worker_num, round_num=round_num,
+            batch_size=batch_size, seed=seed,
+            robust_config=robust_config,
+            robust_stats=stats if robust_config else None,
+            fault_specs=fault_specs,
+            round_timeout=round_timeout,
+            server_kwargs={"buffered_aggregation": buffered_aggregation},
+        )
+        return {
+            "asr": eval_accuracy(trainer, final, backdoor),
+            "clean_acc": eval_accuracy(trainer, final, test_arrays),
+            "robust_rounds": stats.get("rounds", []),
+        }
+
+    on, off = arm(defense), arm(None)
+    result = {
+        "compromised_clients": [int(c) for c in bad],
+        "poisoned_counts": counts,
+        "asr_defended": on["asr"],
+        "asr_undefended": off["asr"],
+        "clean_acc_defended": on["clean_acc"],
+        "clean_acc_undefended": off["clean_acc"],
+        "robust_rounds": on["robust_rounds"],
+    }
+    logging.info(
+        "attack simulation: ASR %.3f defended vs %.3f undefended "
+        "(clean acc %.3f vs %.3f)",
+        result["asr_defended"], result["asr_undefended"],
+        result["clean_acc_defended"], result["clean_acc_undefended"],
+    )
+    return result

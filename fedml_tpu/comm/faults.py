@@ -1,0 +1,258 @@
+"""Seeded fault injection over any communication backend
+(docs/ROBUSTNESS.md "Fault injection").
+
+PR 5's wire path grew real failure handling — elastic round timeout with
+renormalized weights, ``EmptyRoundError`` on an all-dropped round, duplicate
+uploads resolved first-wins, OFFLINE exclusion after consecutive misses —
+but until now those paths were only driven by hand-built unit tests.
+:class:`FaultyCommManager` wraps one rank's transport and injects faults on
+its SEND side (client wrappers fault the uplink, the server wrapper faults
+broadcast legs), so the whole failure surface runs end-to-end under the
+real protocol on any backend (loopback, shm, grpc, mqtt_s3).
+
+Faults (all seeded — a given (seed, rank, message order) replays exactly):
+
+- ``drop=p``      lose the message with probability p
+- ``delay=s[@p]`` deliver s seconds late (prob p, default 1.0) on a timer
+                  thread — the sender never blocks, and delayed uploads can
+                  arrive after the round timeout (the stale-upload path)
+- ``dup=p``       send the message twice (duplicate first-wins path)
+- ``corrupt=p``   flip bytes in the model payload (clip/reject defense path)
+
+Spec string (the ``--fault_spec`` CLI syntax): ``;``-separated per-rank
+entries, ``<rank|*>:<fault>=<val>[,<fault>=<val>...]`` — e.g.
+``"2:drop=1.0;3:delay=0.2@0.5,dup=0.3;*:corrupt=0.05"``. ``*`` applies to
+every rank without an explicit entry (rank 0 is the server).
+
+Protocol stop messages (``finished``) are never faulted: losing one leaks a
+blocked client thread, which tests liveness of the harness rather than the
+protocol's failure handling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+import numpy as np
+
+from fedml_tpu.comm.base import BaseCommunicationManager
+from fedml_tpu.comm.message import FramedMessage, Message
+from fedml_tpu.obs import trace
+
+# payload params eligible for corruption (header scalars stay intact: the
+# fault models a corrupted model payload, not an unparseable frame)
+_CORRUPTIBLE = (Message.MSG_ARG_KEY_MODEL_PARAMS,
+                Message.MSG_ARG_KEY_ENCODED_UPDATE)
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One rank's fault profile. Probabilities in [0, 1]; ``delay`` in
+    seconds; ``corrupt_frac`` is the fraction of payload bytes flipped per
+    corrupted message."""
+
+    drop: float = 0.0
+    delay: float = 0.0
+    delay_prob: float = 1.0
+    dup: float = 0.0
+    corrupt: float = 0.0
+    corrupt_frac: float = 0.01
+
+    def __post_init__(self):
+        for name in ("drop", "delay_prob", "dup", "corrupt"):
+            v = getattr(self, name)
+            if not 0.0 <= v <= 1.0:
+                raise ValueError(f"FaultSpec.{name}={v} must be in [0, 1]")
+        if self.delay < 0:
+            raise ValueError(f"FaultSpec.delay={self.delay} must be >= 0")
+
+    @property
+    def active(self) -> bool:
+        return (self.drop > 0 or self.dup > 0 or self.corrupt > 0
+                or (self.delay > 0 and self.delay_prob > 0))
+
+
+def parse_fault_spec(spec: str) -> dict:
+    """Parse the ``--fault_spec`` syntax into ``{rank_or_'*': FaultSpec}``.
+    Unknown fault names and malformed entries fail loudly — a typo'd fault
+    silently running a clean experiment would be worse than a crash."""
+    out: dict = {}
+    for entry in filter(None, (e.strip() for e in spec.split(";"))):
+        target, sep, faults = entry.partition(":")
+        if not sep or not faults:
+            raise ValueError(
+                f"fault spec entry {entry!r}: expected "
+                "'<rank|*>:<fault>=<val>[,...]'"
+            )
+        target = target.strip()
+        key: int | str = "*" if target == "*" else int(target)
+        if key in out:
+            raise ValueError(f"fault spec: duplicate target {target!r}")
+        kw: dict = {}
+        for f in faults.split(","):
+            name, sep, val = f.strip().partition("=")
+            if not sep:
+                raise ValueError(f"fault {f!r}: expected '<name>=<value>'")
+            name = name.strip()
+            if name == "delay":
+                secs, at, prob = val.partition("@")
+                kw["delay"] = float(secs)
+                if at:
+                    kw["delay_prob"] = float(prob)
+            elif name in ("drop", "dup", "corrupt", "corrupt_frac"):
+                kw[name] = float(val)
+            else:
+                raise ValueError(
+                    f"unknown fault {name!r} (expected drop | delay | dup | "
+                    "corrupt | corrupt_frac)"
+                )
+        out[key] = FaultSpec(**kw)
+    if not out:
+        raise ValueError(f"empty fault spec {spec!r}")
+    return out
+
+
+class FaultyCommManager(BaseCommunicationManager):
+    """Wrap ``inner`` and apply ``spec``'s faults to outgoing messages.
+
+    The receive side delegates untouched (observers land on ``inner``), so
+    the wrapper composes with any backend and with OffloadCommManager.
+    Applied faults are recorded in ``self.applied`` as
+    ``(kind, msg_type, receiver)`` tuples and as ``comm/fault`` instant
+    events on the process tracer."""
+
+    def __init__(self, inner: BaseCommunicationManager, spec: FaultSpec,
+                 rank: int = 0, seed: int = 0):
+        super().__init__()
+        self.inner = inner
+        self.spec = spec
+        self.rank = rank
+        self._rng = np.random.RandomState((seed * 9176 + rank * 131) % (2**31))
+        self._rng_lock = threading.Lock()
+        self.applied: list[tuple[str, int, int]] = []
+
+    # -- receive side: pure delegation ---------------------------------------
+
+    def add_observer(self, observer) -> None:
+        self.inner.add_observer(observer)
+
+    def remove_observer(self, observer) -> None:
+        self.inner.remove_observer(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()
+
+    # -- send side: seeded faults --------------------------------------------
+
+    def _decide(self, msg_type: int, receiver: int) -> dict:
+        """One seeded draw per enabled fault kind (fixed draw pattern per
+        message — outcomes never shift the sequence, so a run replays)."""
+        s = self.spec
+        with self._rng_lock:
+            r = self._rng
+            plan = {
+                "drop": s.drop > 0 and r.random_sample() < s.drop,
+                "corrupt": s.corrupt > 0 and r.random_sample() < s.corrupt,
+                "dup": s.dup > 0 and r.random_sample() < s.dup,
+                "delay": (s.delay > 0 and s.delay_prob > 0
+                          and r.random_sample() < s.delay_prob),
+            }
+        for kind, hit in plan.items():
+            if hit:
+                self.applied.append((kind, msg_type, receiver))
+                trace.event("comm/fault", kind=kind, msg_type=msg_type,
+                            sender=self.rank, receiver=receiver)
+        return plan
+
+    def _corrupt_message(self, msg: Message) -> Message:
+        """Copy ``msg`` with seeded byte flips in its model payload(s)."""
+        out = Message()
+        out.msg_params = dict(msg.msg_params)
+        with self._rng_lock:
+            for key in _CORRUPTIBLE:
+                v = out.msg_params.get(key)
+                if not isinstance(v, np.ndarray):
+                    continue
+                buf = np.array(v)  # owned contiguous copy
+                raw = buf.reshape(-1).view(np.uint8)
+                n_flip = max(1, int(self.spec.corrupt_frac * raw.size))
+                pos = self._rng.randint(0, raw.size, size=n_flip)
+                raw[pos] ^= 0xFF
+                out.msg_params[key] = buf
+        return out
+
+    def _deliver(self, thunks, delay: float) -> None:
+        if delay > 0:
+            t = threading.Timer(delay, lambda: [fn() for fn in thunks])
+            t.daemon = True
+            t.start()
+        else:
+            for fn in thunks:
+                fn()
+
+    @staticmethod
+    def _protected(msg: Message) -> bool:
+        return bool(msg.get("finished"))
+
+    def send_message(self, msg: Message) -> None:
+        if not self.spec.active or self._protected(msg):
+            self.inner.send_message(msg)
+            return
+        plan = self._decide(msg.get_type(), msg.get_receiver_id())
+        if plan["drop"]:
+            return
+        if plan["corrupt"]:
+            msg = self._corrupt_message(msg)
+        sends = 2 if plan["dup"] else 1
+        self._deliver([lambda m=msg: self.inner.send_message(m)] * sends,
+                      self.spec.delay if plan["delay"] else 0.0)
+
+    def broadcast_message(self, msg: Message, receiver_ids: list,
+                          per_receiver: dict | None = None) -> None:
+        if not self.spec.active or self._protected(msg):
+            self.inner.broadcast_message(msg, receiver_ids, per_receiver)
+            return
+        # base implementation frames once and routes each leg through our
+        # _send_framed, where the per-leg faults land
+        super().broadcast_message(msg, receiver_ids, per_receiver)
+
+    def _send_framed(self, frame: FramedMessage, dst: int,
+                     overrides: dict | None = None) -> None:
+        plan = self._decide(frame._header.get(Message.MSG_ARG_KEY_TYPE, 0), dst)
+        if plan["drop"]:
+            return
+        if plan["corrupt"]:
+            # corruption needs a mutable payload copy: rebuild the leg as a
+            # Message (faulted legs give up the zero-copy fast path)
+            m = self._corrupt_message(frame.to_message(dst, overrides))
+            thunk = [lambda: self.inner.send_message(m)]
+        else:
+            thunk = [lambda: self.inner._send_framed(frame, dst, overrides)]
+        self._deliver(thunk * (2 if plan["dup"] else 1),
+                      self.spec.delay if plan["delay"] else 0.0)
+
+
+def wrap_make_comm(make_comm, specs, seed: int = 0, registry: list | None = None):
+    """Wrap a ``make_comm(rank)`` factory so ranks with a fault spec get a
+    :class:`FaultyCommManager`. ``specs`` is a ``{rank|'*': FaultSpec}`` map
+    or a :func:`parse_fault_spec` string; ``registry`` (optional list)
+    collects the created wrappers so harnesses can assert on
+    ``wrapper.applied``."""
+    if isinstance(specs, str):
+        specs = parse_fault_spec(specs)
+
+    def wrapped(rank: int):
+        inner = make_comm(rank)
+        spec = specs.get(rank, specs.get("*"))
+        if spec is None or not spec.active:
+            return inner
+        mgr = FaultyCommManager(inner, spec, rank=rank, seed=seed)
+        if registry is not None:
+            registry.append(mgr)
+        return mgr
+
+    return wrapped

@@ -16,6 +16,7 @@ broadcast materializes the payload bytes once, not N times.
 from __future__ import annotations
 
 import queue
+import threading
 
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.message import FramedMessage, Message
@@ -37,6 +38,37 @@ class LoopbackFabric:
         """Queue already-framed wire data: ``bytes`` or a broadcast's
         ``(head, shared_tail)`` pair."""
         self.queues[receiver].put(data)
+
+
+class OrderedUplinkFabric(LoopbackFabric):
+    """Loopback fabric that holds one message type bound for ``receiver``
+    until ``expected`` distinct senders posted it, then delivers the batch
+    in sender order — pins the server's streaming fold order so bit-identity
+    assertions (streaming vs buffered f64 accumulation) are deterministic
+    even though client threads race. Used by tools/wire_smoke.py,
+    tools/robust_smoke.py, and the wire-path tests."""
+
+    def __init__(self, world_size: int, expected: int, msg_type: int,
+                 receiver: int = 0):
+        super().__init__(world_size)
+        self._expected = expected
+        self._type = msg_type
+        self._receiver = receiver
+        self._held: dict[int, bytes] = {}
+        self._lock = threading.Lock()
+
+    def post(self, msg: Message) -> None:
+        if (msg.get_receiver_id() == self._receiver
+                and msg.get_type() == self._type):
+            with self._lock:
+                self._held[msg.get_sender_id()] = msg.to_bytes()
+                if len(self._held) < self._expected:
+                    return
+                batch, self._held = sorted(self._held.items()), {}
+            for _, data in batch:
+                self.post_raw(self._receiver, data)
+            return
+        super().post(msg)
 
 
 class LoopbackCommManager(BaseCommunicationManager):

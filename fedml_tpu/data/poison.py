@@ -53,24 +53,33 @@ def poison_clients(
     target_label: int = 0,
     trigger: Trigger = Trigger(),
     seed: int = 0,
-) -> tuple[FederatedArrays, np.ndarray]:
-    """Returns (poisoned copy, compromised client ids).
+) -> tuple[FederatedArrays, np.ndarray, dict[int, int]]:
+    """Returns (poisoned copy, compromised client ids, per-client poisoned
+    sample counts keyed by client id).
 
     A ``compromised_frac`` of clients stamp the trigger on ``sample_frac`` of
     their samples and flip those labels to ``target_label`` — the reference's
-    poisoned-loader behavior as one vectorized transform."""
+    poisoned-loader behavior as one vectorized transform. The rounded
+    per-client draw is clamped to the partition size: tiny client shards
+    (``round(sample_frac * n) > n`` near 1.0, or the ``max(1, ...)`` floor on
+    an 0-or-1-sample shard) used to crash ``rng.choice(replace=False)``."""
     rng = np.random.RandomState(seed)
     n_clients = fed.num_clients
     n_bad = max(1, int(round(compromised_frac * n_clients)))
     bad = np.sort(rng.choice(n_clients, n_bad, replace=False))
 
     arrays = {k: v.copy() for k, v in fed.arrays.items()}
+    counts: dict[int, int] = {}
     for c in bad:
         idxs = fed.partition[int(c)]
-        chosen = rng.choice(idxs, max(1, int(round(sample_frac * len(idxs)))), replace=False)
+        n_chosen = min(len(idxs), max(1, int(round(sample_frac * len(idxs)))))
+        counts[int(c)] = n_chosen
+        if n_chosen == 0:  # empty client shard: nothing to poison
+            continue
+        chosen = rng.choice(idxs, n_chosen, replace=False)
         arrays["x"][chosen] = trigger.apply(arrays["x"][chosen])
         arrays["y"][chosen] = target_label
-    return FederatedArrays(arrays, fed.partition), bad
+    return FederatedArrays(arrays, fed.partition), bad, counts
 
 
 def backdoor_test_arrays(

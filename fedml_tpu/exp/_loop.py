@@ -59,6 +59,12 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
         # geometry next to the run so a report reader can tell which
         # execution mode produced the (bit-identical) curve
         logging.info("packed-lane execution: %s", pack)
+    defense = getattr(sim, "defense_summary", lambda: {})()
+    if defense:
+        # robust aggregation (docs/ROBUSTNESS.md): name the active defense
+        # stages up front — a curve trained under clip/DP-noise must never
+        # be mistaken for a plain FedAvg run
+        logging.info("robust defense: %s", defense)
     freq = max(cfg.frequency_of_the_test, 1)
     depth = getattr(sim, "pipeline_depth", 0)
     prefetch = drain = None

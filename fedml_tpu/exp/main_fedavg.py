@@ -93,10 +93,33 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "straggler protocol)")
     parser.add_argument("--group_num", type=int, default=2)
     parser.add_argument("--group_comm_round", type=int, default=2)
-    # robustness knobs (fedavg_robust main_fedavg_robust.py args)
-    parser.add_argument("--norm_bound", type=float, default=0.0)
-    parser.add_argument("--stddev", type=float, default=0.0)
-    parser.add_argument("--robust_rule", type=str, default="mean")
+    # robustness knobs (fedavg_robust main_fedavg_robust.py args;
+    # docs/ROBUSTNESS.md). On --backend sim the defense runs inside the
+    # round program; on the message-passing backends it runs in the
+    # streaming server tally (robust_distributed.RobustDistAggregator).
+    parser.add_argument("--norm_bound", type=float, default=0.0,
+                        help="clip each client delta's L2 norm to this "
+                             "bound (0 = no clipping)")
+    parser.add_argument("--stddev", "--dp_stddev", dest="stddev",
+                        type=float, default=0.0,
+                        help="seeded weak-DP gaussian noise stddev on the "
+                             "aggregate (0 = no noise; --dp_stddev is the "
+                             "docs/ROBUSTNESS.md spelling, --stddev the "
+                             "reference's)")
+    parser.add_argument("--robust_rule", type=str, default="mean",
+                        choices=["mean", "median", "trimmed_mean", "krum"])
+    parser.add_argument("--reservoir_k", type=int, default=0,
+                        help="message-passing backends only: bound the "
+                             "median/trimmed_mean/krum rules to a seeded "
+                             "reservoir of K uploads (0 = keep all = the "
+                             "exact rule; K>0 caps host memory at O(K x "
+                             "model) for huge cohorts)")
+    parser.add_argument("--fault_spec", type=str, default=None,
+                        help="seeded wire-fault injection on the "
+                             "message-passing backends (comm/faults.py): "
+                             "';'-separated '<rank|*>:<fault>=<val>,...' "
+                             "with faults drop|delay[@p]|dup|corrupt, e.g. "
+                             "'2:drop=1.0;*:corrupt=0.05'")
     # update compression (fedml_tpu/compress, docs/COMPRESSION.md)
     parser.add_argument("--compressor", type=str, default="none",
                         help="client->server update codec: none | bf16 | "
@@ -291,10 +314,13 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         # the server's accountant flushes the round's Comm/* record into
         # comm_stats just before this callback fires (fedavg_distributed
         # _done), so bytes-on-wire land in the same metrics stream as
-        # Test/Acc
+        # Test/Acc; ditto the robust tally's Robust/* record
         for crec in comm_stats.get("rounds", []):
             if crec.get("round") == r:
                 rec.update({k: v for k, v in crec.items() if k != "round"})
+        for rrec in robust_stats.get("rounds", []):
+            if rrec.get("round") == r:
+                rec.update({k: v for k, v in rrec.items() if k != "round"})
         if ev is not None and (
             (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
         ):
@@ -321,6 +347,22 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     }
     codec_kwargs = {}
     comm_stats: dict = {}
+    robust_stats: dict = {}
+    robust_kwargs: dict = {}
+    if args.algorithm == "fedavg_robust":
+        from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+        robust_kwargs = {
+            "robust_config": RobustDistConfig(
+                rule=args.robust_rule, norm_bound=args.norm_bound,
+                dp_stddev=args.stddev, dp_seed=cfg.seed,
+                reservoir_k=getattr(args, "reservoir_k", 0),
+            ),
+            "robust_stats": robust_stats,
+        }
+    if getattr(args, "fault_spec", None):
+        robust_kwargs["fault_specs"] = args.fault_spec
+        robust_kwargs["fault_seed"] = cfg.seed
     if getattr(args, "compressor", "none") != "none":
         if getattr(args, "is_mobile", 0):
             raise NotImplementedError(
@@ -361,6 +403,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         init_overrides=overrides,
         **mobile_kwargs,
         **codec_kwargs,
+        **robust_kwargs,
     )
     if comm_stats.get("totals"):
         logging.info("bytes on wire: %s", comm_stats["totals"])
@@ -393,6 +436,11 @@ def _run(args) -> list[dict]:
             "--is_mobile 1 selects the JSON wire format, which only exists "
             "on the message-passing backends — pick --backend "
             "loopback|shm|grpc|mqtt_s3"
+        )
+    if getattr(args, "fault_spec", None) and args.backend == "sim":
+        raise NotImplementedError(
+            "--fault_spec injects wire faults — there is no wire on "
+            "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
         )
     logging.info("devices: %s", jax.devices())
 
@@ -439,7 +487,7 @@ def _run(args) -> list[dict]:
 
     # ---- real message-passing backends (loopback / shm / grpc) ----
     if args.backend != "sim":
-        if args.algorithm not in ("fedavg", "fedprox"):
+        if args.algorithm not in ("fedavg", "fedprox", "fedavg_robust"):
             raise NotImplementedError(
                 f"--backend {args.backend} runs the message-passing FedAvg "
                 f"protocol; --algorithm {args.algorithm} is sim-engine only"

@@ -40,6 +40,7 @@ def _run(args) -> dict:
     from fedml_tpu.models.linear import LogisticRegression
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
+    from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
 
     logging_config(0)
     data_dir = Path(args.data_dir)
@@ -70,6 +71,7 @@ def _run(args) -> dict:
         seed=args.seed,
         pack_lanes=args.pack_lanes,
         pack_capacity_factor=args.pack_capacity_factor,
+        **robust_fields(args),
     )
     sim = FedSim(trainer, ds.train, ds.test_arrays, cfg)
     records, wall = run_rounds(sim, cfg, args.metrics_out)
@@ -166,6 +168,7 @@ Reproduce with: `python -m fedml_tpu.exp.repro_femnist_lr --out REPRO.md`
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    from fedml_tpu.algorithms.robust import add_cli_flags as add_robust_cli_flags
     from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
 
     parser.add_argument("--data_dir", type=str, default="./data/femnist_lr")
@@ -186,6 +189,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
     add_trace_cli_flag(parser)
+    add_robust_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--metrics_out", type=str,
                         default="repro_femnist_lr_metrics.jsonl")

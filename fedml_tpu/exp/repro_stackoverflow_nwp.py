@@ -51,6 +51,7 @@ def _run(args) -> dict:
     from fedml_tpu.models.rnn import RNNStackOverflow
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
+    from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
 
     logging_config(0)
     data_dir = Path(args.data_dir)
@@ -128,6 +129,7 @@ def _run(args) -> dict:
         seed=args.seed,
         pack_lanes=args.pack_lanes,
         pack_capacity_factor=args.pack_capacity_factor,
+        **robust_fields(args),
         # THE row's systems point: population >> cohort. Keep the dataset
         # host-side; each round stages only its 50-client cohort.
         stage_on_device=False,
@@ -246,6 +248,7 @@ Reproduce with: `python -m fedml_tpu.exp.repro_stackoverflow_nwp --test_clients 
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    from fedml_tpu.algorithms.robust import add_cli_flags as add_robust_cli_flags
     from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
 
     parser.add_argument("--data_dir", type=str,
@@ -277,6 +280,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
     add_trace_cli_flag(parser)
+    add_robust_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--train_eval_samples", type=int, default=50_000,
                         help="cap the pooled-train eval subset (None/0 = "

@@ -29,6 +29,7 @@ def _run(args) -> dict:
     from fedml_tpu.models.linear import LogisticRegression
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
+    from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
 
     logging_config(0)
     results = {}
@@ -48,6 +49,7 @@ def _run(args) -> dict:
             frequency_of_the_test=args.frequency_of_the_test, seed=args.seed,
             pack_lanes=args.pack_lanes,
             pack_capacity_factor=args.pack_capacity_factor,
+            **robust_fields(args),
         )
         _, hist = FedSim(trainer, train, test, cfg).run()
         evals = [(h["round"], h["Test/Acc"]) for h in hist if "Test/Acc" in h]
@@ -110,6 +112,7 @@ Reproduce with: `python -m fedml_tpu.exp.repro_synthetic --report REPRO.md`
 
 
 def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    from fedml_tpu.algorithms.robust import add_cli_flags as add_robust_cli_flags
     from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
 
     parser.add_argument("--client_num_in_total", type=int, default=30)
@@ -129,6 +132,7 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
     add_trace_cli_flag(parser)
+    add_robust_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--size_dist", type=str, default="lognormal",
                         choices=["lognormal", "uniform"],

@@ -35,6 +35,16 @@ COMM_DOWNLINK_RATIO = "Comm/DownlinkCompressionRatio"
 # ratio keys are derived, not additive — totals() must never sum them
 _RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
 
+# Robust-aggregation defense keys (docs/ROBUSTNESS.md): per-round mean
+# pre-clip update norm, fraction of the cohort whose delta got clipped, and
+# how many client updates the combine rule discarded (krum keeps one,
+# trimmed mean drops 2k, non-finite wire uploads are rejected). Emitted by
+# the sim engine's robust_aggregator and the message-passing
+# RobustDistAggregator so both defense paths land in one metrics stream.
+ROBUST_UPDATE_NORM = "Robust/UpdateNorm"
+ROBUST_CLIP_FRACTION = "Robust/ClipFraction"
+ROBUST_FILTERED = "Robust/FilteredClients"
+
 
 class CommBytesAccountant:
     """Per-round uplink/downlink byte ledger for the message-passing path.

@@ -109,6 +109,15 @@ class SimConfig:
     compressor: str = "none"
     topk_frac: float = 0.01
     quantize_bits: int = 8
+    # Robust aggregation defense (algorithms/robust.py, docs/ROBUSTNESS.md):
+    # clip -> combine (mean/median/trimmed_mean/krum) -> seeded weak-DP
+    # noise, run inside the round program. Defaults are the no-defense
+    # identity (plain FedAvg). Round metrics gain the Robust/* keys when
+    # any stage is active. A caller-supplied ``aggregator`` takes
+    # precedence; setting both fails loudly at construction.
+    robust_rule: str = "mean"
+    norm_bound: float = 0.0
+    dp_stddev: float = 0.0
     # Sim-mode error feedback keys residuals by cohort slot, which equals
     # client identity only at full participation (rng.sample_clients returns
     # arange there) — enforced at engine construction.
@@ -182,8 +191,40 @@ class FedSim:
                 "(expected 'vmap' or 'scan') — a silent fallback here would "
                 "benchmark or OOM the wrong execution mode"
             )
+        robust_on = (config.robust_rule != "mean" or config.norm_bound > 0
+                     or config.dp_stddev > 0)
+        if robust_on and aggregator is not None:
+            raise ValueError(
+                "SimConfig robust defense flags (robust_rule/norm_bound/"
+                "dp_stddev) conflict with an explicit aggregator= — one of "
+                "them would silently win; configure the defense in exactly "
+                "one place"
+            )
+        if robust_on:
+            from fedml_tpu.algorithms.robust import RobustConfig, robust_aggregator
+
+            aggregator = robust_aggregator(RobustConfig(
+                norm_bound=config.norm_bound, stddev=config.dp_stddev,
+                rule=config.robust_rule,
+            ))
         self.aggregator = aggregator or fedavg_aggregator()
         self.mesh = mesh if mesh is not None else meshlib.client_mesh()
+        if robust_on and config.robust_rule != "mean":
+            # order-statistic rules run over the padded cohort stack; any
+            # padding slots are zero-delta phantoms that bias the statistic
+            # toward the current global — name it loudly
+            n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
+            c_pad = -(-config.client_num_per_round // n_dev) * n_dev
+            if c_pad != config.client_num_per_round:
+                logging.warning(
+                    "robust rule %r runs over a padded cohort stack: %d real "
+                    "clients + %d zero-delta padding slots (cohort not "
+                    "divisible by the %d-way client mesh) — the order "
+                    "statistic is biased toward the current global; prefer "
+                    "client_num_per_round divisible by the mesh",
+                    config.robust_rule, config.client_num_per_round,
+                    c_pad - config.client_num_per_round, n_dev,
+                )
         if config.compressor and config.compressor != "none":
             from fedml_tpu.compress import make_codec
             from fedml_tpu.compress.aggregate import compressed_aggregator
@@ -1206,6 +1247,20 @@ class FedSim:
                 self.config.pack_lanes * self._n_client_shards * self._s_lane,
             "padded_scan_steps":
                 self._c_pad * self.trainer.epochs * self._steps,
+        }
+
+    def defense_summary(self) -> dict:
+        """Static robust-defense accounting (empty when no defense stage is
+        configured): the clip/rule/noise knobs in effect — the observability
+        hook exp loops log at run start (mirrors :meth:`pack_summary`)."""
+        c = self.config
+        if c.robust_rule == "mean" and c.norm_bound <= 0 and c.dp_stddev <= 0:
+            return {}
+        return {
+            "rule": c.robust_rule,
+            "norm_bound": c.norm_bound,
+            "dp_stddev": c.dp_stddev,
+            "aggregator": self.aggregator.name,
         }
 
     def run_round(self, round_idx, global_variables, server_state, root_rng):

@@ -55,9 +55,11 @@ def test_trigger_and_poison_bookkeeping(rng):
     y = rng.randint(1, 4, 100).astype(np.int32)  # labels 1..3, target 0 unused
     part = {c: np.arange(c * per_client, (c + 1) * per_client) for c in range(n_clients)}
     fed = FederatedArrays({"x": x, "y": y}, part)
-    poisoned, bad = poison_clients(fed, compromised_frac=0.4, sample_frac=0.5,
-                                   target_label=0, seed=3)
+    poisoned, bad, counts = poison_clients(fed, compromised_frac=0.4,
+                                           sample_frac=0.5, target_label=0, seed=3)
     assert 1 <= len(bad) <= n_clients
+    assert sorted(counts) == [int(c) for c in bad]
+    assert all(v == per_client // 2 for v in counts.values())
     # clean clients untouched
     clean = [c for c in range(n_clients) if c not in set(bad.tolist())]
     for c in clean:

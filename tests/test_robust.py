@@ -1,0 +1,791 @@
+"""Robust + private aggregation tests (docs/ROBUSTNESS.md): defense math
+(clip bound, rule invariants, BN exclusion), poisoning bookkeeping, the
+streaming wire-path tally vs its buffered bit-exactness oracle, seeded
+fault injection over the loopback protocol, and the end-to-end poisoned
+attack simulation with the defense on/off."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.robust import (
+    RobustConfig,
+    add_weak_dp_noise,
+    clip_deltas,
+    clip_scale,
+    delta_norms,
+    dp_noise_key,
+    flat_delta_norm,
+    flat_norm_mask,
+    krum_select,
+    robust_aggregator,
+    trimmed_mean,
+)
+from fedml_tpu.algorithms.robust_distributed import (
+    BufferedRobustDistAggregator,
+    RobustDistAggregator,
+    RobustDistConfig,
+)
+from fedml_tpu.comm.faults import FaultSpec, FaultyCommManager, parse_fault_spec
+from fedml_tpu.obs import metrics as metricslib
+
+
+# ---------------------------------------------------------------------------
+# defense math (sim path, algorithms/robust.py)
+# ---------------------------------------------------------------------------
+
+
+def test_clip_norm_bound_holds():
+    g = {"params": {"w": jnp.zeros((3, 4)), "b": jnp.zeros(4)}}
+    rng = np.random.RandomState(0)
+    stacked = jax.tree.map(
+        lambda l: jnp.asarray(rng.randn(5, *np.shape(l)) * 3.0, jnp.float32), g
+    )
+    bound = 0.7
+    clipped = clip_deltas(g, stacked, bound)
+    _, norms = delta_norms(g, clipped)
+    assert float(jnp.max(norms)) <= bound * (1 + 1e-5)
+    # an update already inside the bound is untouched (scale == 1)
+    small = jax.tree.map(lambda l: l * 1e-3, stacked)
+    out = clip_deltas(g, small, bound)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(small)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+
+def test_clip_excludes_batch_stats():
+    """A huge BN-statistics delta must not shrink the parameter update."""
+    g = {"params": {"w": jnp.zeros(4)}, "batch_stats": {"mean": jnp.zeros(4)}}
+    stacked = {
+        "params": {"w": jnp.full((2, 4), 0.01)},
+        "batch_stats": {"mean": jnp.full((2, 4), 1e6)},
+    }
+    clipped = clip_deltas(g, stacked, norm_bound=1.0)
+    # param norm 0.02 << 1.0: no clipping despite the enormous BN delta
+    np.testing.assert_allclose(
+        np.asarray(clipped["params"]["w"]), 0.01, rtol=1e-6
+    )
+
+
+def test_trimmed_mean_rejects_degenerate_config():
+    stacked = {"w": jnp.ones((4, 2))}
+    with pytest.raises(ValueError, match="trim_ratio=0.5.*C=4"):
+        trimmed_mean(stacked, trim_ratio=0.5)
+    # valid config still trims
+    big = {"w": jnp.asarray([[1.0], [1.0], [1.0], [1.0], [99.0], [-99.0]])}
+    out = trimmed_mean(big, trim_ratio=0.2)
+    assert abs(float(out["w"][0]) - 1.0) < 0.5
+
+
+def test_krum_rejects_degenerate_config():
+    stacked = {"w": jnp.asarray(np.random.RandomState(0).randn(4, 3), jnp.float32)}
+    with pytest.raises(ValueError, match="num_byzantine=2 with C=4"):
+        krum_select(stacked, num_byzantine=2)
+    assert int(krum_select(stacked, num_byzantine=1)) in range(4)
+
+
+def test_robust_config_validation():
+    with pytest.raises(ValueError, match="unknown robust rule"):
+        RobustConfig(rule="mode")
+    with pytest.raises(ValueError, match="unknown robust rule"):
+        RobustDistConfig(rule="mode")
+    with pytest.raises(ValueError, match="reservoir_k"):
+        RobustDistConfig(reservoir_k=-1)
+    assert not RobustDistConfig().enabled
+    assert RobustDistConfig(norm_bound=0.1).enabled
+
+
+def test_robust_aggregator_emits_metrics():
+    g = {"params": {"w": jnp.zeros(2)}}
+    stacked = {"params": {"w": jnp.asarray([[0.1, 0.1], [0.2, 0.1], [99.0, -99.0]])}}
+    weights = jnp.ones(3)
+    agg = robust_aggregator(RobustConfig(norm_bound=1.0, rule="median"))
+    out, _, m = agg.aggregate(g, stacked, weights, (), jax.random.key(0))
+    assert float(m[metricslib.ROBUST_UPDATE_NORM]) > 1.0
+    assert abs(float(m[metricslib.ROBUST_CLIP_FRACTION]) - 1 / 3) < 1e-6
+    assert float(m[metricslib.ROBUST_FILTERED]) == 2.0
+    assert float(jnp.abs(out["params"]["w"]).max()) <= 1.0 + 1e-5
+
+
+def test_flat_norm_mask_and_delta_norm():
+    import json
+
+    desc = json.dumps([
+        {"path": "params/w", "shape": [3], "dtype": "float32"},
+        {"path": "batch_stats/mean", "shape": [2], "dtype": "float32"},
+    ])
+    mask = flat_norm_mask(desc)
+    np.testing.assert_array_equal(mask, [True, True, True, False, False])
+    delta = np.asarray([3.0, 4.0, 0.0, 1e9, 1e9], np.float32)
+    assert flat_delta_norm(delta, mask) == pytest.approx(5.0)
+    # no BN leaves -> no mask (fast path)
+    assert flat_norm_mask(json.dumps(
+        [{"path": "params/w", "shape": [3], "dtype": "float32"}]
+    )) is None
+    # flat clip factor matches the sim's stacked definition
+    assert float(clip_scale(jnp.float32(5.0), 2.0)) == pytest.approx(0.4)
+    assert float(clip_scale(jnp.float32(1.0), 2.0)) == 1.0
+
+
+def test_dp_noise_is_seeded_and_round_indexed():
+    t = {"w": jnp.zeros(8)}
+    a = add_weak_dp_noise(t, 0.5, dp_noise_key(7, 0))["w"]
+    b = add_weak_dp_noise(t, 0.5, dp_noise_key(7, 0))["w"]
+    c = add_weak_dp_noise(t, 0.5, dp_noise_key(7, 1))["w"]
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+# ---------------------------------------------------------------------------
+# poisoning bookkeeping (data/poison.py)
+# ---------------------------------------------------------------------------
+
+
+def test_poison_clients_clamps_tiny_partitions():
+    from fedml_tpu.data.poison import poison_clients
+    from fedml_tpu.sim.cohort import FederatedArrays
+
+    x = np.random.RandomState(0).rand(5, 4).astype(np.float32)
+    y = np.ones(5, np.int32)
+    # client 0 has ONE sample; sample_frac rounding must not over-draw
+    part = {0: np.asarray([0]), 1: np.asarray([1, 2, 3, 4])}
+    fed = FederatedArrays({"x": x, "y": y}, part)
+    poisoned, bad, counts = poison_clients(
+        fed, compromised_frac=1.0, sample_frac=0.9, target_label=0, seed=0
+    )
+    assert sorted(bad.tolist()) == [0, 1]
+    assert counts[0] == 1  # clamped to the shard size
+    assert counts[1] == 4  # round(0.9 * 4)
+    poisoned_total = int((poisoned.arrays["y"] == 0).sum())
+    assert poisoned_total == sum(counts.values())
+
+
+def test_backdoor_test_arrays_excludes_target_label():
+    from fedml_tpu.data.poison import Trigger, backdoor_test_arrays
+
+    x = np.zeros((6, 4), np.float32)
+    y = np.asarray([0, 1, 2, 0, 1, 2], np.int32)
+    bt = backdoor_test_arrays({"x": x, "y": y}, target_label=0,
+                              trigger=Trigger(size=2, value=5.0))
+    assert len(bt["y"]) == 4 and (bt["y"] == 0).all()
+    assert (bt["x"][:, :2] == 5.0).all()
+
+
+# ---------------------------------------------------------------------------
+# streaming tally vs buffered oracle (wire path)
+# ---------------------------------------------------------------------------
+
+
+def _flat_payloads(n, size=37, seed=0):
+    rng = np.random.RandomState(seed)
+    base = rng.randn(size).astype(np.float32)
+    flats = [rng.randn(size).astype(np.float32).view(np.uint8) for _ in range(n)]
+    weights = [float(w) for w in rng.randint(1, 20, n)]
+    return base, flats, weights
+
+
+def _pair(cfg, n, base):
+    aggs = (RobustDistAggregator(n, cfg), BufferedRobustDistAggregator(n, cfg))
+    for a in aggs:
+        a.get_global = lambda: base.view(np.uint8)
+    return aggs
+
+
+@pytest.mark.parametrize("rule,k", [("mean", 0), ("median", 0), ("median", 2),
+                                    ("trimmed_mean", 0), ("krum", 0)])
+@pytest.mark.parametrize("order", [[0, 1, 2, 3, 4], [4, 2, 0, 3, 1]])
+def test_robust_streaming_matches_buffered_bitwise(rule, k, order):
+    base, flats, weights = _flat_payloads(5)
+    cfg = RobustDistConfig(rule=rule, norm_bound=0.8, dp_stddev=0.02,
+                           dp_seed=11, reservoir_k=k, trim_ratio=0.2,
+                           num_byzantine=1)
+    stream, buf = _pair(cfg, 5, base)
+    for r in range(2):  # two rounds: the noise/reservoir schedules advance
+        for i in order:
+            stream.add_local_trained_result(i, flats[i], weights[i])
+            buf.add_local_trained_result(i, flats[i], weights[i])
+        np.testing.assert_array_equal(stream.aggregate(), buf.aggregate())
+        assert stream.pop_round_stats() == buf.pop_round_stats()
+
+
+def test_robust_streaming_dropped_straggler_renormalization():
+    base, flats, weights = _flat_payloads(5, seed=3)
+    cfg = RobustDistConfig(rule="mean", norm_bound=0.5, dp_stddev=0.01, dp_seed=2)
+    stream, buf = _pair(cfg, 5, base)
+    for i in (4, 0, 2):  # workers 1 and 3 dropped by the timeout
+        stream.add_local_trained_result(i, flats[i], weights[i])
+        buf.add_local_trained_result(i, flats[i], weights[i])
+    np.testing.assert_array_equal(stream.aggregate(), buf.aggregate())
+
+
+def test_robust_duplicate_upload_first_wins():
+    base, flats, weights = _flat_payloads(2)
+    dup = np.full(37, 7.0, np.float32).view(np.uint8)
+    cfg = RobustDistConfig(rule="mean", norm_bound=0.5)
+    outs = []
+    for agg in _pair(cfg, 2, base):
+        agg.add_local_trained_result(0, flats[0], weights[0])
+        agg.add_local_trained_result(0, dup, 999.0)  # ignored
+        assert agg.add_local_trained_result(1, flats[1], weights[1])
+        outs.append(agg.aggregate())
+    np.testing.assert_array_equal(*outs)
+
+
+def test_reservoir_bounds_memory_and_stays_unbiased_shape():
+    base, flats, weights = _flat_payloads(8)
+    cfg = RobustDistConfig(rule="median", reservoir_k=3)
+    agg = RobustDistAggregator(8, cfg)
+    agg.get_global = lambda: base.view(np.uint8)
+    for i in range(8):
+        agg.add_local_trained_result(i, flats[i], weights[i])
+        assert len(agg._reservoir) <= 3  # bounded during the round
+    out = agg.aggregate().view(np.float32)
+    assert out.shape == (37,) and np.isfinite(out).all()
+    # exact arm (k=0) keeps everything
+    agg2 = RobustDistAggregator(8, RobustDistConfig(rule="median"))
+    agg2.get_global = lambda: base.view(np.uint8)
+    for i in range(8):
+        agg2.add_local_trained_result(i, flats[i], weights[i])
+    assert len(agg2._reservoir) == 8
+
+
+def test_non_finite_rejected_under_dp_only_defense():
+    """A DP-noise-only config (no clip, mean rule) must still reject
+    non-finite uploads — any defended tally owes the accumulator finiteness."""
+    base, flats, weights = _flat_payloads(2)
+    hostile = flats[0].view(np.float32).copy()
+    hostile[0] = np.inf
+    agg = RobustDistAggregator(2, RobustDistConfig(dp_stddev=0.01))
+    agg.get_global = lambda: base.view(np.uint8)
+    agg.add_local_trained_result(0, hostile.view(np.uint8), 9.0)
+    agg.add_local_trained_result(1, flats[1], weights[1])
+    out = agg.aggregate().view(np.float32)
+    assert np.isfinite(out).all()
+    assert agg.pop_round_stats()[metricslib.ROBUST_FILTERED] == 1
+
+
+def test_non_finite_in_bn_coordinates_rejected():
+    """The clip norm excludes BN statistics, but finiteness must not: a
+    corrupted BN-stat coordinate still rejects the upload."""
+    import json
+
+    desc = json.dumps([
+        {"path": "params/w", "shape": [4], "dtype": "float32"},
+        {"path": "batch_stats/mean", "shape": [2], "dtype": "float32"},
+    ])
+    base = np.zeros(6, np.float32)
+    cfg = RobustDistConfig(rule="mean", norm_bound=1.0)
+    agg = RobustDistAggregator(2, cfg, model_desc=desc)
+    agg.get_global = lambda: base.view(np.uint8)
+    hostile = np.asarray([0.1, 0.1, 0.1, 0.1, np.nan, 0.0], np.float32)
+    clean = np.full(6, 0.2, np.float32)
+    agg.add_local_trained_result(0, hostile.view(np.uint8), 5.0)
+    agg.add_local_trained_result(1, clean.view(np.uint8), 1.0)
+    out = agg.aggregate().view(np.float32)
+    np.testing.assert_allclose(out, clean, rtol=1e-6)  # only the clean fold
+    assert agg.pop_round_stats()[metricslib.ROBUST_FILTERED] == 1
+
+
+def test_rule_fallback_when_survivors_too_few():
+    """krum/trimmed_mean with fewer survivors than the rule supports must
+    not raise at round close (that would wedge the protocol on the timer
+    thread) — the close degrades to the coordinate median, identically in
+    both arms."""
+    base, flats, weights = _flat_payloads(4)
+    for cfg in (RobustDistConfig(rule="krum", num_byzantine=1),
+                RobustDistConfig(rule="trimmed_mean", trim_ratio=0.5)):
+        outs = []
+        for agg in _pair(cfg, 4, base):
+            for i in (1, 3):  # only 2 survivors: krum needs 4, trimmed needs >2k
+                agg.add_local_trained_result(i, flats[i], weights[i])
+            outs.append(agg.aggregate())
+            assert agg.pop_round_stats()[metricslib.ROBUST_FILTERED] == 1
+        np.testing.assert_array_equal(*outs)
+        # the fallback IS the median of the two survivors
+        med = np.median(np.stack([flats[1].view(np.float32),
+                                  flats[3].view(np.float32)]), axis=0)
+        np.testing.assert_allclose(outs[0].view(np.float32), med, rtol=1e-6)
+
+
+def test_non_finite_upload_rejected():
+    base, flats, weights = _flat_payloads(3)
+    cfg = RobustDistConfig(rule="mean", norm_bound=0.5)
+    hostile = flats[0].view(np.float32).copy()
+    hostile[3] = np.nan
+    stream, buf = _pair(cfg, 3, base)
+    outs = []
+    for agg in (stream, buf):
+        agg.add_local_trained_result(0, hostile.view(np.uint8), 50.0)
+        agg.add_local_trained_result(1, flats[1], weights[1])
+        agg.add_local_trained_result(2, flats[2], weights[2])
+        outs.append(agg.aggregate())
+        rec = agg.pop_round_stats()
+        assert rec[metricslib.ROBUST_FILTERED] == 1
+    np.testing.assert_array_equal(*outs)
+    assert np.isfinite(outs[0].view(np.float32)).all()
+    # all-hostile round: previous global kept verbatim
+    agg = RobustDistAggregator(1, cfg)
+    agg.get_global = lambda: base.view(np.uint8)
+    agg.add_local_trained_result(0, hostile.view(np.uint8), 1.0)
+    np.testing.assert_array_equal(agg.aggregate().view(np.float32), base)
+
+
+@pytest.mark.parametrize("spec", ["none", "q8", "topk"])
+def test_robust_compressed_streaming_matches_buffered(spec):
+    from fedml_tpu.algorithms.robust_distributed import (
+        BufferedRobustCompressedDistAggregator,
+        RobustCompressedDistAggregator,
+    )
+    from fedml_tpu.compress import make_codec
+
+    codec = make_codec(spec, topk_frac=0.25)
+    rng = np.random.RandomState(7)
+    base = rng.randn(40).astype(np.float32)
+    cfg = RobustDistConfig(rule="mean", norm_bound=0.6, dp_stddev=0.01, dp_seed=5)
+    encs, weights = [], [3.0, 1.0, 5.0]
+    for i in range(3):
+        tree = {"w": np.asarray(rng.randn(8, 5), np.float32)}
+        encs.append(jax.tree.map(
+            np.asarray, codec.encode(tree, jax.random.key(i))
+        ))
+    stream = RobustCompressedDistAggregator(3, cfg, codec)
+    buf = BufferedRobustCompressedDistAggregator(3, cfg, codec)
+    stream.get_global = buf.get_global = lambda: base.view(np.uint8)
+    for i in (2, 0, 1):
+        stream.add_local_trained_result(i, encs[i], weights[i])
+        buf.add_local_trained_result(i, encs[i], weights[i])
+    np.testing.assert_array_equal(stream.aggregate(), buf.aggregate())
+    assert not hasattr(stream, "model_dict")
+
+
+# ---------------------------------------------------------------------------
+# fault injection (comm/faults.py)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_fault_spec_errors():
+    with pytest.raises(ValueError, match="unknown fault"):
+        parse_fault_spec("1:jitter=0.5")
+    with pytest.raises(ValueError, match="expected"):
+        parse_fault_spec("nonsense")
+    with pytest.raises(ValueError, match="duplicate target"):
+        parse_fault_spec("1:drop=0.5;1:dup=0.5")
+    with pytest.raises(ValueError, match="must be in"):
+        FaultSpec(drop=1.5)
+    spec = parse_fault_spec("0:delay=0.2@0.5;*:drop=0.1")
+    assert spec[0].delay == 0.2 and spec[0].delay_prob == 0.5
+    assert spec["*"].drop == 0.1 and spec["*"].active
+
+
+def _msg(receiver=0, payload=None):
+    from fedml_tpu.comm.message import Message
+
+    m = Message(3, 1, receiver)
+    m.add_params("model_params",
+                 payload if payload is not None
+                 else np.arange(32, dtype=np.float32))
+    return m
+
+
+def test_fault_drop_dup_and_protected_finished():
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(drop=1.0), rank=1, seed=0)
+    mgr.send_message(_msg())
+    assert fabric.queues[0].empty()
+    assert mgr.applied and mgr.applied[0][0] == "drop"
+    fin = _msg()
+    fin.add_params("finished", 1)
+    mgr.send_message(fin)  # stop messages are never faulted
+    assert not fabric.queues[0].empty()
+
+    fabric2 = LoopbackFabric(2)
+    dup = FaultyCommManager(LoopbackCommManager(fabric2, 1),
+                            FaultSpec(dup=1.0), rank=1, seed=0)
+    dup.send_message(_msg())
+    assert fabric2.queues[0].qsize() == 2
+
+
+def test_fault_corrupt_is_seeded_and_payload_only():
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.comm.message import Message
+
+    payload = np.arange(64, dtype=np.float32)
+
+    def corrupted_once(seed):
+        fabric = LoopbackFabric(2)
+        mgr = FaultyCommManager(
+            LoopbackCommManager(fabric, 1),
+            FaultSpec(corrupt=1.0, corrupt_frac=0.1), rank=1, seed=seed,
+        )
+        mgr.send_message(_msg(payload=payload.copy()))
+        got = Message.from_bytes(fabric.queues[0].get_nowait())
+        return np.asarray(got.get("model_params"))
+
+    a, b, c = corrupted_once(3), corrupted_once(3), corrupted_once(4)
+    assert not np.array_equal(a, payload)  # bytes actually flipped
+    np.testing.assert_array_equal(a, b)  # seeded: same seed, same flips
+    assert not np.array_equal(a, c)  # different seed, different flips
+    # the original caller-side array is never mutated
+    np.testing.assert_array_equal(payload, np.arange(64, dtype=np.float32))
+
+
+def test_fault_delay_delivers_late_without_blocking():
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(delay=0.15), rank=1, seed=0)
+    t0 = time.perf_counter()
+    mgr.send_message(_msg())
+    assert time.perf_counter() - t0 < 0.1  # sender did not block
+    assert fabric.queues[0].empty()
+    time.sleep(0.4)
+    assert not fabric.queues[0].empty()
+
+
+def test_fault_broadcast_legs():
+    """Per-leg faults on the encode-once broadcast path: one leg dropped,
+    the others delivered."""
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+    from fedml_tpu.comm.message import Message
+
+    fabric = LoopbackFabric(4)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 0),
+                            FaultSpec(drop=0.5), rank=0, seed=1)
+    msg = Message(2, 0, 1)
+    msg.add_params("model_params", np.ones(16, np.float32))
+    mgr.broadcast_message(msg, [1, 2, 3])
+    delivered = sum(not fabric.queues[r].empty() for r in (1, 2, 3))
+    dropped = sum(1 for kind, _, _ in mgr.applied if kind == "drop")
+    assert delivered == 3 - dropped
+    assert 1 <= dropped <= 2  # seed 1: some but not all legs dropped
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: protocol under faults (loopback)
+# ---------------------------------------------------------------------------
+
+
+def _blob_setup(workers=4, samples=24, seed=11):
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, test = gaussian_blobs(n_clients=workers, samples_per_client=samples,
+                                 num_classes=4, seed=seed)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.2), epochs=1)
+    return trainer, train, test
+
+
+def test_elastic_timeout_drop_fault_streaming_matches_buffered():
+    """A client whose uplink is ALWAYS dropped becomes a straggler: the
+    elastic timeout renormalizes it away, and the robust streaming tally
+    stays bit-identical to the buffered oracle under that schedule."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
+
+    trainer, train, _ = _blob_setup()
+    specs = {3: FaultSpec(drop=1.0)}  # worker rank 3 never uploads
+    defense = RobustDistConfig(rule="mean", norm_bound=0.4, dp_stddev=0.01,
+                               dp_seed=9)
+
+    def run(buffered):
+        # 3 live uplinks per round (rank 3's are dropped at the wrapper)
+        fabric = OrderedUplinkFabric(
+            5, 3, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        )
+        stats: dict = {}
+        per_round = []
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=4, round_num=3, batch_size=8,
+            make_comm=lambda r: LoopbackCommManager(fabric, r),
+            robust_config=defense, robust_stats=stats, fault_specs=specs,
+            round_timeout=0.5,
+            on_round_done=lambda r, v: per_round.append(
+                [np.asarray(l).copy() for l in jax.tree.leaves(v)]
+            ),
+            server_kwargs={"buffered_aggregation": buffered},
+        )
+        return final, per_round, stats
+
+    s_final, s_rounds, s_stats = run(False)
+    b_final, b_rounds, b_stats = run(True)
+    assert len(s_rounds) == len(b_rounds) == 3
+    for sr, br in zip(s_rounds, b_rounds):
+        for a, b in zip(sr, br):
+            np.testing.assert_array_equal(a, b)
+    for a, b in zip(jax.tree.leaves(s_final), jax.tree.leaves(b_final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert s_stats["rounds"] == b_stats["rounds"]
+
+
+def test_duplicate_fault_is_absorbed_first_wins():
+    """dup=1.0 on one client's uplink: every upload arrives twice and the
+    tally's first-wins rule absorbs the copies — the run completes and
+    matches a fault-free run up to fold-order rounding."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.comm.faults import wrap_make_comm
+
+    trainer, train, _ = _blob_setup()
+
+    def run(specs):
+        registry: list = []
+        kw = {}
+        if specs:
+            kw = {"fault_specs": specs, "fault_seed": 1}
+        final = run_distributed_fedavg_loopback(
+            trainer, train, worker_num=4, round_num=3, batch_size=8, **kw
+        )
+        return final
+
+    clean = run(None)
+    dup = run({2: FaultSpec(dup=1.0)})
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(dup)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_robust_stats_flushed_before_round_callback():
+    """The per-round Robust/* record must be visible to the round callback
+    (main_fedavg merges metrics by round index there) — same ordering
+    contract as the compressed server's comm_stats flush."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+
+    trainer, train, _ = _blob_setup()
+    stats: dict = {}
+    seen: list = []
+
+    def cb(r, _v):
+        seen.append((r, [rec["round"] for rec in stats.get("rounds", [])]))
+
+    run_distributed_fedavg_loopback(
+        trainer, train, worker_num=4, round_num=3, batch_size=8,
+        robust_config=RobustDistConfig(rule="mean", norm_bound=0.4),
+        robust_stats=stats, on_round_done=cb,
+    )
+    assert len(seen) == 3
+    for r, recorded in seen:
+        assert r in recorded, (r, recorded)
+
+
+def test_duplicate_broadcast_leg_does_not_desync_rounds():
+    """dup on the SERVER's broadcast legs: a duplicated S2C sync makes the
+    client re-train the same round (the sync carries the authoritative
+    round index), and its duplicate upload is absorbed first-wins — the run
+    completes instead of desyncing the client round counter forever."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+
+    trainer, train, _ = _blob_setup()
+
+    def run(specs):
+        kw = {"fault_specs": specs, "fault_seed": 2} if specs else {}
+        return run_distributed_fedavg_loopback(
+            trainer, train, worker_num=4, round_num=3, batch_size=8, **kw
+        )
+
+    clean = run(None)
+    dup = run({0: FaultSpec(dup=1.0)})  # every downlink leg duplicated
+    # re-training a round is deterministic (same model, same round rng), so
+    # the duplicated uploads are byte-identical and first-wins makes the
+    # run exactly reproduce the clean one
+    for a, b in zip(jax.tree.leaves(clean), jax.tree.leaves(dup)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_corrupt_fault_defended_run_stays_finite():
+    """corrupt=1.0 on one client: every one of its uploads has flipped
+    bytes; the robust tally clips or rejects them and the global model
+    stays finite with the defense engaged."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+
+    trainer, train, _ = _blob_setup()
+    stats: dict = {}
+    final = run_distributed_fedavg_loopback(
+        trainer, train, worker_num=4, round_num=3, batch_size=8,
+        robust_config=RobustDistConfig(rule="mean", norm_bound=0.4),
+        robust_stats=stats,
+        fault_specs={2: FaultSpec(corrupt=1.0, corrupt_frac=0.3)},
+        fault_seed=5,
+    )
+    for leaf in jax.tree.leaves(final):
+        assert np.isfinite(np.asarray(leaf)).all()
+    rounds = stats["rounds"]
+    assert len(rounds) == 3
+    # every round the corrupted upload was clipped or rejected
+    assert all(
+        r[metricslib.ROBUST_CLIP_FRACTION] > 0 or r[metricslib.ROBUST_FILTERED] > 0
+        for r in rounds
+    )
+
+
+def test_all_uplinks_dropped_empty_round_error():
+    """drop=1.0 on EVERY client: the server never hears an upload, the
+    round cannot close, and closing the empty tally raises EmptyRoundError
+    — the loud-failure contract, driven through the fault wrapper."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        EmptyRoundError,
+        FedAvgClientManager,
+        FedAvgServerManager,
+        init_template,
+    )
+    from fedml_tpu.comm.faults import wrap_make_comm
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    trainer, train, _ = _blob_setup(workers=2)
+    template, flat, desc = init_template(trainer, train.arrays, 8)
+    fabric = LoopbackFabric(3)
+    make_comm = wrap_make_comm(lambda r: LoopbackCommManager(fabric, r),
+                               {1: FaultSpec(drop=1.0), 2: FaultSpec(drop=1.0)})
+    server = FedAvgServerManager(make_comm(0), 2, 2, flat, desc,
+                                 round_timeout=0.2)
+    clients = [
+        FedAvgClientManager(make_comm(r), r, 3, trainer, train, 8, template)
+        for r in (1, 2)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.register_message_receive_handlers()
+    server.send_init_msg()
+    st = threading.Thread(target=server.comm.handle_receive_message, daemon=True)
+    st.start()
+    try:
+        time.sleep(1.0)  # > round_timeout: plenty of time to (not) hear back
+        assert server.round_idx == 0  # no round ever closed
+        with pytest.raises(EmptyRoundError, match="no worker uploads"):
+            server.aggregator.aggregate()
+    finally:
+        for c in clients:
+            c.finish()
+        server.finish()
+        st.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# attack simulation: poisoned population, defense on/off
+# ---------------------------------------------------------------------------
+
+
+def test_attack_simulation_defense_bounds_asr():
+    """Backdoor ASR over the real loopback protocol: ~1.0 with the defense
+    off, driven to ~0 by clip+median — with a delay/dup fault spec active
+    on one benign rank, so the defense and failure paths run together."""
+    from fedml_tpu.algorithms.robust_distributed import run_attack_simulation
+    from fedml_tpu.data.poison import Trigger
+
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, test = gaussian_blobs(n_clients=6, samples_per_client=48,
+                                 num_classes=4, seed=5)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.3), epochs=2)
+    res = run_attack_simulation(
+        trainer, train, test, worker_num=6, round_num=8, batch_size=16,
+        defense=RobustDistConfig(rule="median", norm_bound=0.3),
+        compromised_frac=0.34, sample_frac=1.0, target_label=0,
+        trigger=Trigger(size=4, value=3.0), poison_seed=2, seed=3,
+        fault_specs={5: FaultSpec(delay=0.02, dup=0.5)},
+    )
+    assert res["asr_undefended"] > 0.8  # the attack actually lands
+    assert res["asr_defended"] < 0.25  # ...and the defense bounds it
+    assert res["asr_defended"] < res["asr_undefended"] - 0.5
+    assert res["clean_acc_defended"] > 0.8  # defense did not wreck utility
+    assert len(res["robust_rounds"]) == 8
+    assert res["compromised_clients"] and res["poisoned_counts"]
+
+
+# ---------------------------------------------------------------------------
+# sim engine wiring
+# ---------------------------------------------------------------------------
+
+
+def test_sim_robust_config_builds_defense_and_emits_metrics():
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    trainer, train, test = _blob_setup(workers=4)
+    cfg = SimConfig(client_num_in_total=4, client_num_per_round=4,
+                    batch_size=8, comm_round=2, frequency_of_the_test=1,
+                    robust_rule="median", norm_bound=1.0, dp_stddev=0.0,
+                    pipeline_depth=0)
+    sim = FedSim(trainer, train, test, cfg)
+    assert sim.aggregator.name == "robust-median"
+    summary = sim.defense_summary()
+    assert summary["rule"] == "median" and summary["norm_bound"] == 1.0
+    _, hist = sim.run()
+    assert all(metricslib.ROBUST_UPDATE_NORM in rec for rec in hist)
+    assert all(metricslib.ROBUST_CLIP_FRACTION in rec for rec in hist)
+    # no defense -> empty summary, no Robust/* keys
+    plain = FedSim(trainer, train, test, SimConfig(
+        client_num_in_total=4, client_num_per_round=4, batch_size=8,
+        comm_round=1, pipeline_depth=0))
+    assert plain.defense_summary() == {}
+
+
+def test_sim_padded_order_stat_cohort_warns(caplog):
+    """An order-statistic rule over a cohort the mesh pads must be named
+    loudly: the padding slots are zero-delta phantoms biasing the rule."""
+    import logging as _logging
+
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    trainer, train, test = _blob_setup(workers=4)
+    with caplog.at_level(_logging.WARNING):
+        FedSim(trainer, train, test, SimConfig(
+            client_num_in_total=4, client_num_per_round=3, batch_size=8,
+            comm_round=1, robust_rule="median"))
+    assert any("padded cohort stack" in r.message for r in caplog.records)
+
+
+def test_sim_robust_config_conflicts_with_explicit_aggregator():
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    trainer, train, test = _blob_setup(workers=4)
+    agg = robust_aggregator(RobustConfig(rule="median"))
+    with pytest.raises(ValueError, match="conflict"):
+        FedSim(trainer, train, test, SimConfig(
+            client_num_in_total=4, client_num_per_round=4, batch_size=8,
+            comm_round=1, robust_rule="median"), aggregator=agg)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def test_robust_smoke_tool_runs():
+    """tools/robust_smoke.py is the tier-1 guard docs/ROBUSTNESS.md points
+    at — run it in-process (mirrors the wire/pack smokes' wiring)."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "robust_smoke.py"
+    spec = importlib.util.spec_from_file_location("robust_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
