@@ -59,6 +59,12 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
         # geometry next to the run so a report reader can tell which
         # execution mode produced the (bit-identical) curve
         logging.info("packed-lane execution: %s", pack)
+    shard = getattr(sim, "shard_summary", lambda: {})()
+    if shard:
+        # sharded client models (SimConfig.shard_rules): record the rule
+        # set, mesh geometry, and lowering mode next to the run so a
+        # report reader can tell which parallelism produced the curve
+        logging.info("shard_summary: %s", shard)
     defense = getattr(sim, "defense_summary", lambda: {})()
     if defense:
         # robust aggregation (docs/ROBUSTNESS.md): name the active defense

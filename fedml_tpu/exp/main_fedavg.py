@@ -166,6 +166,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="lane-length head room over the expected "
                              "per-shard cohort load; overflow draws spill "
                              "to an extra sequential pass")
+    parser.add_argument("--mesh_shape", type=str, default=None,
+                        help="2-D device mesh 'CLIENTSxMODEL' (e.g. 2x4): "
+                             "cohort parallelism across the client axis, "
+                             "tensor/FSDP model parallelism within a "
+                             "client across the model axis (docs/"
+                             "PERFORMANCE.md 'Sharded client models'); "
+                             "validated against the device count")
+    parser.add_argument("--shard_rules", type=str, default=None,
+                        help="partition-rule set sharding the client model "
+                             "over the mesh's model axis: transformer_tp | "
+                             "transformer_fsdp | cnn_tp | cnn_fsdp "
+                             "(fedml_tpu.parallel.rules); unset = every "
+                             "client model lives whole on one chip. "
+                             "Requires --backend sim")
     parser.add_argument("--pipeline_depth", type=int, default=-1,
                         help="pipelined round driver: -1 auto (double-"
                              "buffered staging prefetch + deferred metrics "
@@ -427,6 +441,7 @@ def _run(args) -> list[dict]:
     from fedml_tpu.data import load_partition_data
     from fedml_tpu.models import create_model
     from fedml_tpu.obs.metrics import MetricsLogger, logging_config
+    from fedml_tpu.parallel.mesh import parse_mesh_shape
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
@@ -441,6 +456,13 @@ def _run(args) -> list[dict]:
         raise NotImplementedError(
             "--fault_spec injects wire faults — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
+        )
+    if (getattr(args, "shard_rules", None)
+            or getattr(args, "mesh_shape", None)) and args.backend != "sim":
+        raise NotImplementedError(
+            "--shard_rules/--mesh_shape configure the sim engine's device "
+            "mesh and jitted round programs; the message-passing backends "
+            "train whole models per worker — use --backend sim"
         )
     logging.info("devices: %s", jax.devices())
 
@@ -476,6 +498,8 @@ def _run(args) -> list[dict]:
                         else args.pipeline_depth),
         pack_lanes=getattr(args, "pack_lanes", 0),
         pack_capacity_factor=getattr(args, "pack_capacity_factor", 1.25),
+        mesh_shape=parse_mesh_shape(getattr(args, "mesh_shape", None)),
+        shard_rules=getattr(args, "shard_rules", None),
         compressor=getattr(args, "compressor", "none"),
         topk_frac=getattr(args, "topk_frac", 0.01),
         quantize_bits=getattr(args, "quantize_bits", 8),

@@ -50,6 +50,7 @@ def _run(args) -> dict:
     from fedml_tpu.exp._loop import run_rounds
     from fedml_tpu.models.rnn import RNNStackOverflow
     from fedml_tpu.obs.metrics import logging_config
+    from fedml_tpu.parallel.mesh import parse_mesh_shape
     from fedml_tpu.sim.engine import FedSim, SimConfig
     from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
 
@@ -129,6 +130,8 @@ def _run(args) -> dict:
         seed=args.seed,
         pack_lanes=args.pack_lanes,
         pack_capacity_factor=args.pack_capacity_factor,
+        mesh_shape=parse_mesh_shape(args.mesh_shape),
+        shard_rules=args.shard_rules or None,
         **robust_fields(args),
         # THE row's systems point: population >> cohort. Keep the dataset
         # host-side; each round stages only its 50-client cohort.
@@ -279,6 +282,14 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="lane-length head room over the expected "
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
+    parser.add_argument("--mesh_shape", type=str, default=None,
+                        help="2-D 'CLIENTSxMODEL' device mesh for sharded "
+                             "client models (docs/PERFORMANCE.md 'Sharded "
+                             "client models'); unset = 1-D client mesh")
+    parser.add_argument("--shard_rules", type=str, default=None,
+                        help="partition-rule set sharding the client model "
+                             "over the mesh's model axis (e.g. "
+                             "transformer_fsdp); unset = unsharded")
     add_trace_cli_flag(parser)
     add_robust_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)

@@ -32,6 +32,15 @@ class MultiHeadSelfAttention(nn.Module):
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32  # compute dtype (bf16 on TPU); params stay f32
+    # model-parallel mesh axis (docs/PERFORMANCE.md "Sharded client
+    # models"): when set, head-axis sharding constraints pin q/k/v to the
+    # tensor-parallel layout the partition rules put on the qkv kernel, so
+    # each model shard attends over its own heads. Requires tracing under
+    # the plan's mesh (parallel/dispatch.py provides the context). Note:
+    # GSPMD partitions the xla attention path by heads; the pallas flash
+    # kernel is an opaque custom call to the partitioner and runs on
+    # gathered heads unless wrapped in shard_map.
+    mp_axis: str | None = None
     # flash kernel tile sizes, tuned on a v5e at T=1024, D_head=128: a tall
     # 256-row query block with the whole 1024-key sequence in one block beat
     # the 128x128 default by ~4% end-to-end MFU (_pick_block clamps both to T)
@@ -40,6 +49,8 @@ class MultiHeadSelfAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        from fedml_tpu.parallel.rules import constrain
+
         b, t, c = x.shape
         head_dim = c // self.num_heads
         qkv = nn.Dense(3 * c, use_bias=False, name="qkv", dtype=self.dtype)(x)
@@ -49,6 +60,11 @@ class MultiHeadSelfAttention(nn.Module):
             return a.reshape(b, t, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
+        if self.mp_axis:
+            hspec = (None, self.mp_axis, None, None)
+            q = constrain(q, hspec)
+            k = constrain(k, hspec)
+            v = constrain(v, hspec)
         if self.attn_impl == "flash":
             o = flash_attention(q, k, v, causal=True,
                                 block_q=self.block_q, block_k=self.block_k)
@@ -70,22 +86,34 @@ class Block(nn.Module):
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    # model-parallel mesh axis: when set, the MLP hidden activation is
+    # pinned to the column-parallel layout of the Dense_0 kernel and the
+    # block output to the replicated boundary layout (the Megatron
+    # between-blocks contract) — see parallel/rules.py act_spec
+    mp_axis: str | None = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        from fedml_tpu.parallel.rules import constrain
+
         h = nn.LayerNorm(dtype=self.dtype)(x)
         x = x + MultiHeadSelfAttention(
             self.num_heads, self.attn_impl, self.sp_axis, self.dropout_rate,
-            dtype=self.dtype,
+            dtype=self.dtype, mp_axis=self.mp_axis,
         )(h, train=train)
         h = nn.LayerNorm(dtype=self.dtype)(x)
         c = x.shape[-1]
         m = nn.Dense(self.mlp_ratio * c, dtype=self.dtype)(h)
+        if self.mp_axis:
+            m = constrain(m, (None, None, self.mp_axis))
         m = nn.gelu(m)
         m = nn.Dense(c, dtype=self.dtype)(m)
         if self.dropout_rate:
             m = nn.Dropout(self.dropout_rate, deterministic=not train)(m)
-        return x + m
+        out = x + m
+        if self.mp_axis:
+            out = constrain(out, (None, None, None))
+        return out
 
 
 class TransformerLM(nn.Module):
@@ -102,6 +130,12 @@ class TransformerLM(nn.Module):
     sp_axis: str = "sp"
     dropout_rate: float = 0.0
     dtype: jnp.dtype = jnp.float32
+    # model-parallel mesh axis for tensor-parallel plans (docs/
+    # PERFORMANCE.md "Sharded client models"): threaded to every Block so
+    # block-boundary activations carry explicit sharding constraints. The
+    # engine sets it automatically when a TP rule set is active
+    # (sim/engine.py); leave None for unsharded / FSDP-gather execution.
+    mp_axis: str | None = None
     # LM-head matmul dtype, independent of the block compute dtype: an f32
     # head runs the MXU at half rate but skips two [B, T, V]-sized dtype
     # converts (logits + their gradient). Which side wins is shape-dependent;
@@ -134,6 +168,7 @@ class TransformerLM(nn.Module):
                 sp_axis=self.sp_axis,
                 dropout_rate=self.dropout_rate,
                 dtype=self.dtype,
+                mp_axis=self.mp_axis,
                 name=f"block_{i}",
             )(h, train)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)

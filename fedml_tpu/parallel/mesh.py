@@ -17,6 +17,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 CLIENT_AXIS = "clients"
 SILO_AXIS = "silo"
+# model-parallel axis: tensor/FSDP sharding WITHIN one client's model
+# (parallel/rules.py partition rules name it) — orthogonal to the client
+# axis that carries cohort parallelism
+MODEL_AXIS = "model"
 
 
 def client_mesh(devices=None) -> Mesh:
@@ -30,9 +34,83 @@ def silo_mesh(num_silos: int, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if n % num_silos:
-        raise ValueError(f"{n} devices not divisible into {num_silos} silo groups")
+        raise ValueError(
+            f"silo_mesh(num_silos={num_silos}): {n} available devices do "
+            f"not divide evenly into {num_silos} silo groups "
+            f"({n} % {num_silos} = {n % num_silos})"
+        )
     arr = np.asarray(devices).reshape(num_silos, n // num_silos)
     return Mesh(arr, (CLIENT_AXIS, SILO_AXIS))
+
+
+def shard_mesh(mesh_shape, devices=None) -> Mesh:
+    """2-D mesh [clients, model]: cohort parallelism × within-client model
+    parallelism (docs/PERFORMANCE.md "Sharded client models").
+
+    ``mesh_shape`` is ``(n_client_shards, n_model_shards)``. The product
+    must divide the available device count evenly — validated here with an
+    error naming both numbers, instead of the opaque numpy reshape failure
+    a bad shape used to produce. When the product is a proper divisor of
+    the device count (e.g. a 2x2 mesh on 8 devices), the first
+    ``clients * model`` devices are used — a deterministic subset, so
+    repeated constructions agree; non-divisor products are rejected
+    rather than silently stranding a remainder of the mesh."""
+    devices = list(devices if devices is not None else jax.devices())
+    try:
+        clients, model = (int(x) for x in mesh_shape)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"mesh_shape must be a (clients, model) pair, got {mesh_shape!r}"
+        ) from None
+    if clients < 1 or model < 1:
+        raise ValueError(
+            f"mesh_shape axes must be >= 1, got {(clients, model)}"
+        )
+    n, want = len(devices), clients * model
+    if want > n or n % want:
+        raise ValueError(
+            f"mesh_shape {(clients, model)} requires {want} devices "
+            f"(clients x model) but {n} are available, and {want} does "
+            f"not divide {n} evenly ({n} % {want} = {n % want})"
+            if want <= n else
+            f"mesh_shape {(clients, model)} requires {want} devices "
+            f"(clients x model) but only {n} are available"
+        )
+    arr = np.asarray(devices[:want]).reshape(clients, model)
+    return Mesh(arr, (CLIENT_AXIS, MODEL_AXIS))
+
+
+def parse_mesh_shape(text: str | None):
+    """CLI spelling of a (clients, model) mesh shape: ``'2x4'`` or
+    ``'2,4'`` -> ``(2, 4)``; None/empty passes through (no 2-D mesh)."""
+    if not text:
+        return None
+    parts = text.lower().replace("x", ",").split(",")
+    try:
+        clients, model = (int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"--mesh_shape expects 'CLIENTSxMODEL' (e.g. 2x4), got {text!r}"
+        ) from None
+    return (clients, model)
+
+
+def named_sharding(mesh: Mesh, spec) -> NamedSharding:
+    """Build a NamedSharding from a PartitionSpec on ``mesh``, validating
+    that every axis the spec names exists on the mesh — a typo'd axis name
+    otherwise surfaces as a deep XLA lowering error with the spec lost."""
+    unknown = [
+        ax
+        for entry in spec
+        for ax in (entry if isinstance(entry, tuple) else (entry,))
+        if ax is not None and ax not in mesh.axis_names
+    ]
+    if unknown:
+        raise ValueError(
+            f"PartitionSpec {spec} names mesh axes {unknown} not present "
+            f"on this mesh (axes: {list(mesh.axis_names)})"
+        )
+    return NamedSharding(mesh, spec)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -28,7 +28,6 @@ from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
 from fedml_tpu.obs import trace
-from fedml_tpu.parallel import compat
 from fedml_tpu.parallel import mesh as meshlib
 from fedml_tpu.sim import cohort as cohortlib
 
@@ -122,6 +121,25 @@ class SimConfig:
     # client identity only at full participation (rng.sample_clients returns
     # arange there) — enforced at engine construction.
     error_feedback: bool = True
+    # Sharded client models (docs/PERFORMANCE.md "Sharded client models"):
+    # mesh_shape = (n_client_shards, n_model_shards) builds a 2-D
+    # (clients, model) device mesh — cohort parallelism across the client
+    # axis, tensor/FSDP parallelism WITHIN one client's model across the
+    # model axis. Validated against the available device count
+    # (parallel/mesh.shard_mesh). None keeps the 1-D all-clients mesh.
+    mesh_shape: tuple | None = None
+    # Partition-rule plan for the client model (parallel/rules.py): the
+    # name of a built-in rule set ("transformer_tp", "transformer_fsdp",
+    # "cnn_tp", "cnn_fsdp", ...) mapping every param (and its optimizer
+    # state) to a PartitionSpec over the model axis. When the plan shards
+    # anything, the round is lowered via pjit with explicit in/out
+    # shardings (parallel/dispatch.py) instead of the client-mapped
+    # shard_map program; FSDP-style sets (gather_compute) keep the round
+    # bit-identical to the unsharded program on the transformer path
+    # (tools/shard_smoke.py guards it; BN batch statistics carry a ~1 ULP
+    # cross-program fusion caveat, parallel/rules.py module note).
+    # None = unsharded (every client model lives whole on one chip).
+    shard_rules: str | None = None
     # Pipelined round driver (sim/prefetch.py, docs/PERFORMANCE.md): a
     # background thread builds and device_puts the NEXT dispatch's staging
     # (index maps / batch stacks) while the current one executes, and round
@@ -208,7 +226,23 @@ class FedSim:
                 rule=config.robust_rule,
             ))
         self.aggregator = aggregator or fedavg_aggregator()
-        self.mesh = mesh if mesh is not None else meshlib.client_mesh()
+        if config.mesh_shape is not None and mesh is not None:
+            raise ValueError(
+                "SimConfig.mesh_shape and an explicit mesh= were both "
+                "given — one of them would silently win; configure the "
+                "mesh in exactly one place"
+            )
+        if mesh is not None:
+            self.mesh = mesh
+        elif config.mesh_shape is not None:
+            self.mesh = meshlib.shard_mesh(config.mesh_shape)
+        elif config.shard_rules:
+            # the flagship geometry when no shape is given: one client at
+            # a time, the whole mesh given to its model (the model that
+            # doesn't fit one chip is WHY the rules are on)
+            self.mesh = meshlib.shard_mesh((1, len(jax.devices())))
+        else:
+            self.mesh = meshlib.client_mesh()
         if robust_on and config.robust_rule != "mean":
             # order-statistic rules run over the padded cohort stack; any
             # padding slots are zero-delta phantoms that bias the statistic
@@ -267,11 +301,109 @@ class FedSim:
                 "mismatched topology would silently isolate clients"
             )
 
+        # -- partition-rule model parallelism (docs/PERFORMANCE.md
+        # "Sharded client models"): resolve the rule set into a
+        # PartitionSpec plan over the model variables, rebinding the
+        # trainer's module with the model axis when the plan carries
+        # block-boundary activation constraints (TP) -------------------------
+        from fedml_tpu.parallel import dispatch as displib
+
+        self._var_specs = None
+        self._shard_gather = False
+        self._spmd = False
+        if config.shard_rules:
+            from fedml_tpu.parallel import rules as ruleslib
+
+            if meshlib.MODEL_AXIS not in self.mesh.axis_names:
+                raise ValueError(
+                    f"shard_rules={config.shard_rules!r} needs a mesh with "
+                    f"a '{meshlib.MODEL_AXIS}' axis — set SimConfig."
+                    "mesh_shape=(clients, model) or leave mesh= unset for "
+                    "the default 1 x all-devices model mesh"
+                )
+            if self._per_client:
+                raise ValueError(
+                    "shard_rules shards ONE broadcast global model over "
+                    "the mesh; per-client aggregators (decentralized/"
+                    "gossip) keep a model per client and need the "
+                    "unsharded path"
+                )
+            if config.pack_lanes > 0:
+                raise NotImplementedError(
+                    "pack_lanes with shard_rules is not wired yet: packed "
+                    "lanes run on the client-mapped shard_map programs, "
+                    "sharded models on the pjit programs — run sharded "
+                    "rounds on the padded path"
+                )
+            if config.block_dispatch:
+                raise ValueError(
+                    "block_dispatch scans whole rounds inside one program "
+                    "and cannot split the sharded round's train/aggregate "
+                    "dispatch boundary; leave block_dispatch off with "
+                    "shard_rules"
+                )
+            if jax.process_count() > 1:
+                raise NotImplementedError(
+                    "shard_rules on a multi-controller (jax.distributed) "
+                    "mesh is not wired yet"
+                )
+            ruleset = ruleslib.rule_set(config.shard_rules)
+            self._shard_gather = ruleset.gather_compute
+            if ruleset.act_spec is not None and hasattr(
+                trainer.module, "mp_axis"
+            ):
+                trainer = dataclasses.replace(
+                    trainer,
+                    module=trainer.module.clone(mp_axis=meshlib.MODEL_AXIS),
+                )
+                self.trainer = trainer
+            with self.mesh:
+                self._var_specs = ruleslib.match_partition_rules(
+                    ruleset.rules, self._variables_shape_tree()
+                )
+            self._spmd = displib.plan_is_sharded(self._var_specs)
+            if not self._spmd:
+                logging.warning(
+                    "shard_rules=%r matched no shardable leaf on this "
+                    "model (every rule resolved to the replicate "
+                    "default) — the round runs on the client-mapped "
+                    "shard_map path and the mesh's %d-way '%s' axis is "
+                    "pure replication",
+                    config.shard_rules,
+                    self.mesh.shape[meshlib.MODEL_AXIS], meshlib.MODEL_AXIS,
+                )
+            # the spec->NamedSharding tree is static: build it once here
+            # instead of on every dispatch (named_sharding validates each
+            # leaf's axis names, a per-leaf Python cost)
+            self._var_shardings = displib.to_shardings(
+                self.mesh, self._var_specs
+            )
+        elif meshlib.MODEL_AXIS in self.mesh.axis_names:
+            # a model axis with no shard plan is pure replication: every
+            # model-column device computes the same round redundantly —
+            # name it loudly instead of silently delivering 1/(model-axis)
+            # of the mesh's throughput
+            logging.warning(
+                "mesh has a %d-way '%s' axis but no shard_rules — the "
+                "model axis devices replicate the same work; set "
+                "SimConfig.shard_rules to shard the client model (or drop "
+                "mesh_shape)",
+                self.mesh.shape[meshlib.MODEL_AXIS], meshlib.MODEL_AXIS,
+            )
+        # eval programs: plain jit normally; under a shard plan they trace
+        # under the mesh context (module-side constraints) and consume the
+        # model in whatever layout the round program left it
+        jit_ = (
+            (lambda f: displib.jit_sharded(f, self.mesh))
+            if self._spmd else jax.jit
+        )
+
         self._local_train = local_train_fn or make_local_train(trainer)
         self._can_eval = hasattr(trainer, "eval_batch")
         self._local_eval = make_local_eval(trainer) if self._can_eval else None
         self._client_eval_fn = (
-            jax.jit(jax.vmap(self._local_eval, in_axes=(None, 0)))
+            jit_(lambda v, d: jax.vmap(self._local_eval, in_axes=(None, 0))(
+                self._compute_view(v), d))
             if self._can_eval
             else None
         )
@@ -344,13 +476,16 @@ class FedSim:
         # the trace stream is the compile event (obs/trace.py)
         self._dispatched: set[str] = set()
 
-        # The round program is shard_mapped manually over the ``clients`` axis:
-        # each device runs an ordinary vmap over its local cohort slice, then
-        # the client stacks are all-gathered for the aggregator. (Leaving the
-        # client axis to GSPMD instead hits an XLA limitation: vmap expresses
-        # per-client conv kernel gradients as feature-grouped convolutions,
-        # which the SPMD partitioner cannot split along the group axis.)
-        # Other mesh axes (e.g. ``silo`` intra-client DP) stay automatic.
+        # Every compiled round program is lowered through the compile
+        # dispatcher (parallel/dispatch.py): pjit with explicit in/out
+        # shardings when the plan shards the model, the manual shard_map
+        # lowering otherwise — each device then runs an ordinary vmap over
+        # its local cohort slice and the client stacks are all-gathered for
+        # the aggregator. (Leaving the client axis to GSPMD on conv models
+        # hits an XLA limitation: vmap expresses per-client conv kernel
+        # gradients as feature-grouped convolutions, which the SPMD
+        # partitioner cannot split along the group axis.) Other mesh axes
+        # (e.g. ``silo`` intra-client DP) stay automatic.
         from jax.sharding import PartitionSpec as P
 
         cohort_spec = P(meshlib.CLIENT_AXIS)
@@ -362,20 +497,63 @@ class FedSim:
         # buffers — deterministically garbage for the per-client stack, and
         # intermittently corrupted broadcast-mode params under full-suite
         # memory pressure. Donate only on runtimes with the current
-        # jax.shard_map API.
+        # jax.shard_map API. (The pjit programs below are unaffected; they
+        # gate donation on the backend implementing it instead.)
         self._donate = (0,) if hasattr(jax, "shard_map") else ()
-        self._round_fn = jax.jit(
-            compat.shard_map(
-                self._round_impl,
-                mesh=self.mesh,
-                in_specs=(var_spec, P(), cohort_spec, cohort_spec, cohort_spec, P()),
+        if self._spmd:
+            # Two-program sharded round: a pjit TRAIN program emits the
+            # cohort's update stack at a program boundary, then a pjit
+            # AGGREGATE program reduces it. The boundary layout follows
+            # the plan's contract: gather_compute (FSDP-style) plans use a
+            # REPLICATED boundary — all cross-shard movement is
+            # concat/slice, never a reassociated reduction, which is what
+            # keeps them bit-identical to the shard_map path
+            # (tools/shard_smoke.py) at the cost of a full [C, model]
+            # stack per device there (gather plans replicate params for
+            # compute anyway, so the boundary is not their binding
+            # memory constraint). TP plans instead keep the stack SHARDED
+            # (clients x each leaf's own model-axis spec) through the
+            # boundary — O(local shard) per chip end to end, the
+            # too-big-for-one-chip contract — accepting the ~1 ULP
+            # cross-shard reduce association TP already carries.
+            self._stack_spec = stack_spec = (
+                P() if self._shard_gather
+                else jax.tree_util.tree_map(
+                    lambda s: P(meshlib.CLIENT_AXIS, *s), self._var_specs,
+                    is_leaf=lambda x: isinstance(
+                        x, jax.sharding.PartitionSpec),
+                )
+            )
+            self._spmd_train_fn = displib.lower(
+                self._spmd_train_impl, mesh=self.mesh,
+                in_specs=(self._var_specs, cohort_spec, P(), P()),
+                out_specs=(stack_spec, P()),
+            )
+            # donate the old global (in/out specs match, and the train
+            # dispatch is ordered before the aggregate on the device
+            # stream, so aliasing is safe) plus the exclusively-owned
+            # stack/loss buffers — without it the big-model path holds two
+            # full model copies live across the aggregate
+            agg_donate = (
+                (0, 2, 3) if jax.default_backend() != "cpu" else ()
+            )
+            self._spmd_agg_fn = displib.lower(
+                self._spmd_agg_impl, mesh=self.mesh,
+                in_specs=(self._var_specs, P(), stack_spec, P(), P(), P(),
+                          P()),
+                out_specs=(self._var_specs, P(), P()),
+                donate_argnums=agg_donate,
+            )
+            self._round_fn = None
+        else:
+            self._round_fn = displib.lower(
+                self._round_impl, mesh=self.mesh,
+                in_specs=(var_spec, P(), cohort_spec, cohort_spec,
+                          cohort_spec, P()),
                 out_specs=(var_spec, P(), P()),
-                axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                check_vma=False,
-            ),
-            donate_argnums=self._donate,
-        )
-        self._eval_fn = jax.jit(self._eval_impl) if self._can_eval else None
+                donate_argnums=self._donate,
+            )
+        self._eval_fn = jit_(self._eval_impl) if self._can_eval else None
 
         # Device-resident dataset + in-program cohort gather: the TPU-first
         # answer to the reference's per-batch .to(device) traffic — ship the
@@ -391,24 +569,27 @@ class FedSim:
             if config.block_dispatch is not None
             else (self._on_device
                   and next(iter(self.mesh.devices.flat)).platform != "cpu")
-        ) and self._on_device and not self._pack
+        ) and self._on_device and not self._pack and not self._spmd
         if self._on_device:
             self._dataset = self._put(
                 {k: np.asarray(v) for k, v in train_data.arrays.items()},
                 self._rep,
             )
-            self._gather_round_fn = jax.jit(
-                compat.shard_map(
-                    self._gather_round_impl,
-                    mesh=self.mesh,
+            if self._spmd:
+                self._spmd_gather_train_fn = displib.lower(
+                    self._spmd_gather_train_impl, mesh=self.mesh,
+                    in_specs=(self._var_specs, P(), cohort_spec, P(), P()),
+                    out_specs=(self._stack_spec, P()),
+                )
+                self._gather_round_fn = None
+            else:
+                self._gather_round_fn = displib.lower(
+                    self._gather_round_impl, mesh=self.mesh,
                     in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
                               cohort_spec, P()),
                     out_specs=(var_spec, P(), P()),
-                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                    check_vma=False,
-                ),
-                donate_argnums=self._donate,
-            )
+                    donate_argnums=self._donate,
+                )
 
         if self._pack:
             # Packed-lane programs (docs/PERFORMANCE.md): a zero-buffer init,
@@ -418,15 +599,10 @@ class FedSim:
             from fedml_tpu.core.trainer import make_lane_step
 
             self._lane_step = make_lane_step(trainer)
-            self._packed_buf_fn = jax.jit(
-                compat.shard_map(
-                    self._packed_buf_impl,
-                    mesh=self.mesh,
-                    in_specs=(P(),),
-                    out_specs=(cohort_spec,) * 4,
-                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                    check_vma=False,
-                )
+            self._packed_buf_fn = displib.lower(
+                self._packed_buf_impl, mesh=self.mesh,
+                in_specs=(P(),),
+                out_specs=(cohort_spec,) * 4,
             )
             if self._on_device:
                 pass_impl = self._packed_gather_pass_impl
@@ -442,26 +618,16 @@ class FedSim:
             # holding two [C_pad, model] copies live. Same legacy-lowering
             # guard as self._donate (see the donation note above).
             buf_donate = buf_args if hasattr(jax, "shard_map") else ()
-            self._packed_pass_fn = jax.jit(
-                compat.shard_map(
-                    pass_impl,
-                    mesh=self.mesh,
-                    in_specs=pass_specs,
-                    out_specs=(cohort_spec,) * 4,
-                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                    check_vma=False,
-                ),
+            self._packed_pass_fn = displib.lower(
+                pass_impl, mesh=self.mesh,
+                in_specs=pass_specs,
+                out_specs=(cohort_spec,) * 4,
                 donate_argnums=buf_donate,
             )
-            self._packed_agg_fn = jax.jit(
-                compat.shard_map(
-                    self._packed_agg_impl,
-                    mesh=self.mesh,
-                    in_specs=(P(), P()) + (cohort_spec,) * 6 + (P(),),
-                    out_specs=(P(), P(), P()),
-                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                    check_vma=False,
-                ),
+            self._packed_agg_fn = displib.lower(
+                self._packed_agg_impl, mesh=self.mesh,
+                in_specs=(P(), P()) + (cohort_spec,) * 6 + (P(),),
+                out_specs=(P(), P(), P()),
                 donate_argnums=(
                     (2, 3, 4, 5) if hasattr(jax, "shard_map") else ()
                 ),
@@ -491,13 +657,14 @@ class FedSim:
                 self._train_eval_idx = self._put(
                     eidx.reshape(steps, bs), self._rep
                 )
-                self._eval_gather_fn = jax.jit(self._eval_gather_impl)
+                self._eval_gather_fn = jit_(self._eval_gather_impl)
                 # per-client analogue: gather each chunk's batches from the
                 # resident dataset, then the same vmapped local eval
-                self._client_eval_gather_fn = jax.jit(
+                self._client_eval_gather_fn = jit_(
                     lambda variables, dataset, idx: jax.vmap(
                         self._local_eval, in_axes=(None, 0)
-                    )(variables, self._gather_batches(dataset, idx))
+                    )(self._compute_view(variables),
+                      self._gather_batches(dataset, idx))
                 )
             else:
                 self._train_eval_batches = cohortlib.batch_array(
@@ -566,17 +733,27 @@ class FedSim:
 
     def _aggregate_tail(self, global_variables, server_state, local_vars,
                         weights, num_steps, train_loss, rng):
-        # The round's server side, shared verbatim by the padded and packed
-        # execution modes: all_gather the cohort stack, derive tau, run the
-        # aggregation rule, and assemble round metrics. Runs per client-shard
-        # inside shard_map.
+        # The round's server side, shared verbatim by the padded, packed,
+        # and sharded execution modes: all_gather the cohort stack, derive
+        # tau, run the aggregation rule, and assemble round metrics. Runs
+        # per client-shard inside shard_map — except under a shard plan
+        # (self._spmd), where it is its own global-view pjit program whose
+        # inputs already arrive as full replicated stacks, so the gather is
+        # the identity and the reduce association matches the manual path's
+        # gathered full-stack reduce exactly.
         from fedml_tpu.parallel.mesh import CLIENT_AXIS
 
         c_local = weights.shape[0]
-        shard_idx = jax.lax.axis_index(CLIENT_AXIS)
-        # Full cohort stack for the aggregator (robust rules need every
-        # client's model: median/krum/clipping are cross-client).
-        gather = partial(jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True)
+        if self._spmd:
+            shard_idx = 0
+            gather = lambda x: x  # noqa: E731 — inputs are the full stacks
+        else:
+            shard_idx = jax.lax.axis_index(CLIENT_AXIS)
+            # Full cohort stack for the aggregator (robust rules need every
+            # client's model: median/krum/clipping are cross-client).
+            gather = partial(
+                jax.lax.all_gather, axis_name=CLIENT_AXIS, axis=0, tiled=True
+            )
         stacked = jax.tree.map(gather, local_vars)
         all_weights = gather(weights)
         all_losses = gather(train_loss)
@@ -655,6 +832,69 @@ class FedSim:
         batches = self._gather_batches(dataset, idx)
         return self._round_impl(
             global_variables, server_state, batches, weights, num_steps, rng
+        )
+
+    # -- sharded client models (SimConfig.shard_rules) -----------------------
+
+    def _compute_view(self, variables):
+        """The model layout the training/eval math runs in: under an
+        FSDP-style gather_compute plan the sharded-at-rest model is pinned
+        replicated (one all-gather per leaf — concat, bit-exact), so every
+        arithmetic op sees the tensors the unsharded program sees; TP plans
+        and unsharded runs pass through untouched."""
+        if self._spmd and self._shard_gather:
+            from fedml_tpu.parallel import dispatch as displib
+
+            return displib.replicate(variables, self.mesh)
+        return variables
+
+    def _spmd_train_impl(self, global_variables, batches, num_steps, rng):
+        # Global-view client training (the pjit half of the sharded round):
+        # one vmap over the WHOLE cohort — slot ids are literal (no
+        # axis_index), rng chains identical to the manual program's
+        # global-slot fold_ins — with GSPMD partitioning the client axis
+        # per the in_shardings and the model axes per the rule plan. The
+        # update stack exits at the plan's boundary layout (replicated for
+        # gather_compute exactness, sharded for TP memory) — see the
+        # program-construction comment in __init__.
+        global_variables = self._compute_view(global_variables)
+        C = num_steps.shape[0]
+        keys = jax.vmap(lambda i: jax.random.fold_in(rng, i))(jnp.arange(C))
+        if self.config.cohort_execution == "scan":
+            local_vars, train_metrics = jax.lax.map(
+                lambda args: self._local_train(global_variables, *args),
+                (batches, keys, num_steps),
+            )
+        else:
+            local_vars, train_metrics = jax.vmap(
+                self._local_train, in_axes=(None, 0, 0, 0),
+                spmd_axis_name=meshlib.CLIENT_AXIS,
+            )(global_variables, batches, keys, num_steps)
+        return local_vars, train_metrics["train_loss"]
+
+    def _spmd_gather_train_impl(self, global_variables, dataset, idx,
+                                num_steps, rng):
+        # on-device-dataset variant: gather the cohort's batches in HBM
+        # through the one canonical batch-gather definition, then train
+        return self._spmd_train_impl(
+            global_variables, self._gather_batches(dataset, idx), num_steps,
+            rng,
+        )
+
+    def _spmd_agg_impl(self, global_variables, server_state, local_vars,
+                       train_loss, weights, num_steps, rng):
+        # The aggregation half of the sharded round. Under gather_compute
+        # plans the stack arrives fully replicated (in_shardings P()), so
+        # the shared aggregate tail reduces it with the manual path's
+        # exact association and the new global re-shards at the
+        # out_shardings (a slice per shard — exact). Under TP plans the
+        # stack stays sharded through the boundary (O(local shard) per
+        # chip) and GSPMD partitions the reduce — the ~1 ULP association
+        # caveat TP already carries.
+        global_variables = self._compute_view(global_variables)
+        return self._aggregate_tail(
+            global_variables, server_state, local_vars, weights, num_steps,
+            train_loss, rng,
         )
 
     # -- packed-lane execution (SimConfig.pack_lanes) ------------------------
@@ -841,6 +1081,8 @@ class FedSim:
         """Compiled R-round block program (cached per R)."""
         from jax.sharding import PartitionSpec as P
 
+        from fedml_tpu.parallel import dispatch as displib
+
         if not hasattr(self, "_block_fns"):
             self._block_fns = {}
         if n_rounds not in self._block_fns:
@@ -848,16 +1090,11 @@ class FedSim:
             var_spec = (
                 P(meshlib.CLIENT_AXIS) if self._per_client else P()
             )
-            self._block_fns[n_rounds] = jax.jit(
-                compat.shard_map(
-                    self._block_impl,
-                    mesh=self.mesh,
-                    in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
-                              cohort_spec, P()),
-                    out_specs=(var_spec, P(), P()),
-                    axis_names=frozenset({meshlib.CLIENT_AXIS}),
-                    check_vma=False,
-                ),
+            self._block_fns[n_rounds] = displib.lower(
+                self._block_impl, mesh=self.mesh,
+                in_specs=(var_spec, P(), P(), cohort_spec, cohort_spec,
+                          cohort_spec, P()),
+                out_specs=(var_spec, P(), P()),
                 donate_argnums=self._donate,
             )
         return self._block_fns[n_rounds]
@@ -903,6 +1140,12 @@ class FedSim:
                 "run_block is the padded block-dispatch path; packed rounds "
                 "(pack_lanes > 0) dispatch one program per pass instead"
             )
+        if self._spmd:
+            raise ValueError(
+                "run_block scans whole rounds inside one program; sharded "
+                "rounds (shard_rules) dispatch a train and an aggregate "
+                "program per round instead"
+            )
         idxs, weights, num_steps, rngs = (
             staged if staged is not None
             else self._stage_block(start_round, n_rounds, root_rng)
@@ -916,6 +1159,8 @@ class FedSim:
             )
 
     def _eval_impl(self, variables, batches):
+        variables = self._compute_view(variables)
+
         def step(carry, batch):
             return carry, self.trainer.eval_batch(variables, batch)
 
@@ -941,6 +1186,29 @@ class FedSim:
         sample.setdefault("mask", jnp.ones((self.config.batch_size,), jnp.float32))
         return self.trainer.init(jax.random.key(self.config.seed), sample)
 
+    def _variables_shape_tree(self) -> Pytree:
+        """Abstract model variables (shapes/dtypes only) for partition-rule
+        planning: ``jax.eval_shape`` over ``trainer.init``, so planning a
+        too-big-for-one-chip model never materializes it."""
+        sample = {
+            name: jax.ShapeDtypeStruct(
+                (min(self.config.batch_size, arr.shape[0]),) + arr.shape[1:],
+                arr.dtype,
+            )
+            for name, arr in self.train_data.arrays.items()
+        }
+        sample.setdefault(
+            "mask",
+            jax.ShapeDtypeStruct(
+                (min(self.config.batch_size,
+                     self.train_data.num_samples),), np.float32
+            ),
+        )
+        return jax.eval_shape(
+            partial(self.trainer.init, jax.random.key(self.config.seed)),
+            sample,
+        )
+
     def init_round_variables(self, overrides: Pytree | None = None) -> Pytree:
         """Model state in the engine's layout: a replicated global model, or —
         per-client mode — an identical-init stacked [C_pad, ...] model set
@@ -957,6 +1225,10 @@ class FedSim:
 
             v = graft_params(jax.tree.map(np.asarray, dict(v)), dict(overrides))
         if not self._per_client:
+            if self._spmd:
+                # sharded-at-rest layout: each leaf placed per its
+                # partition rule (multihost is excluded at construction)
+                return jax.device_put(v, self._var_shardings)
             return self._put(v, self._rep)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
         c_pad = -(-self.config.client_num_in_total // n_dev) * n_dev
@@ -1000,9 +1272,14 @@ class FedSim:
             }
             weights = np.concatenate([weights, np.zeros(pad, np.float32)])
             num_steps = np.concatenate([num_steps, np.zeros(pad, np.int32)])
+        # sharded rounds (pjit) take the tiny [C] cohort vectors replicated
+        # — explicit in_shardings reject a mismatched committed layout
+        scalar_sharding = (
+            self._rep if self._spmd else meshlib.client_sharded(self.mesh)
+        )
         batches = self._put(batches, self._shard)
-        weights = self._put(weights, meshlib.client_sharded(self.mesh))
-        num_steps = self._put(num_steps, meshlib.client_sharded(self.mesh))
+        weights = self._put(weights, scalar_sharding)
+        num_steps = self._put(num_steps, scalar_sharding)
         return batches, weights, num_steps
 
     def _round_budgets(self, cohort, round_idx: int) -> np.ndarray:
@@ -1052,10 +1329,11 @@ class FedSim:
         (-1 = empty slot); the round program gathers rows in HBM."""
         idx, weights, num_steps = self._host_cohort_indices(cohort, round_idx)
         sharded = meshlib.client_sharded(self.mesh)
+        scalar_sharding = self._rep if self._spmd else sharded
         return (
             self._put(idx, sharded),
-            self._put(weights, sharded),
-            self._put(num_steps, sharded),
+            self._put(weights, scalar_sharding),
+            self._put(num_steps, scalar_sharding),
         )
 
     def _sample_round_cohort(self, round_idx: int) -> np.ndarray:
@@ -1200,6 +1478,32 @@ class FedSim:
                             first=self._first_dispatch("packed")):
                 return self._run_packed(staged, global_variables, server_state)
         data, weights, num_steps, rkey = staged
+        if self._spmd:
+            # sharded round: train dispatch, then aggregate dispatch — both
+            # enqueue asynchronously, so the split costs no host sync.
+            # Normalize caller-held layouts first (a checkpoint restore or
+            # a fresh aggregator state may arrive in another sharding;
+            # device_put short-circuits when it already matches).
+            global_variables = jax.device_put(
+                global_variables, self._var_shardings)
+            server_state = jax.device_put(server_state, self._rep)
+            with trace.span("engine/dispatch", program="spmd_train",
+                            first=self._first_dispatch("spmd_train")):
+                if self._on_device:
+                    stack, losses = self._spmd_gather_train_fn(
+                        global_variables, self._dataset, data, num_steps,
+                        rkey,
+                    )
+                else:
+                    stack, losses = self._spmd_train_fn(
+                        global_variables, data, num_steps, rkey
+                    )
+            with trace.span("engine/dispatch", program="spmd_agg",
+                            first=self._first_dispatch("spmd_agg")):
+                return self._spmd_agg_fn(
+                    global_variables, server_state, stack, losses, weights,
+                    num_steps, rkey,
+                )
         if self._on_device:
             with trace.span("engine/dispatch", program="gather",
                             first=self._first_dispatch("gather")):
@@ -1247,6 +1551,34 @@ class FedSim:
                 self.config.pack_lanes * self._n_client_shards * self._s_lane,
             "padded_scan_steps":
                 self._c_pad * self.trainer.epochs * self._steps,
+        }
+
+    def shard_summary(self) -> dict:
+        """Static sharded-model accounting (empty when no shard plan is
+        configured): the rule set, mesh geometry, lowering mode, and how
+        many variable leaves actually shard — the observability hook exp
+        loops log at run start (mirrors :meth:`pack_summary`)."""
+        if not self.config.shard_rules:
+            return {}
+        from jax.sharding import PartitionSpec
+
+        from fedml_tpu.parallel import dispatch as displib
+
+        leaves = jax.tree_util.tree_leaves(
+            self._var_specs, is_leaf=lambda x: isinstance(x, PartitionSpec)
+        )
+        return {
+            "shard_rules": self.config.shard_rules,
+            "mesh": {
+                ax: int(n) for ax, n in
+                zip(self.mesh.axis_names, self.mesh.devices.shape)
+            },
+            "mode": "pjit" if self._spmd else "shard_map",
+            "gather_compute": self._shard_gather,
+            "sharded_leaves": sum(
+                1 for s in leaves if displib.spec_is_sharded(s)
+            ),
+            "total_leaves": len(leaves),
         }
 
     def defense_summary(self) -> dict:

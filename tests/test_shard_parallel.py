@@ -1,0 +1,436 @@
+"""Partition-rule model parallelism: the rule matcher, the 2-D mesh
+helpers, the pjit/shard_map compile dispatcher, and the sharded engine
+round (docs/PERFORMANCE.md "Sharded client models")."""
+
+import dataclasses
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.models.transformer import TransformerLM
+from fedml_tpu.parallel import dispatch as displib
+from fedml_tpu.parallel import rules as ruleslib
+from fedml_tpu.parallel.mesh import (
+    CLIENT_AXIS,
+    MODEL_AXIS,
+    client_mesh,
+    named_sharding,
+    parse_mesh_shape,
+    shard_mesh,
+)
+from fedml_tpu.sim.cohort import FederatedArrays
+from fedml_tpu.sim.engine import FedSim, SimConfig
+
+
+# ---------------------------------------------------------------------------
+# rule matcher
+# ---------------------------------------------------------------------------
+
+
+def _lm_shapes(D=16, H=2, L=2, V=32, T=8):
+    m = TransformerLM(vocab_size=V, embed_dim=D, num_layers=L, num_heads=H,
+                      max_len=T)
+    return jax.eval_shape(
+        lambda: dict(m.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(0)},
+            jnp.zeros((2, T), jnp.int32), train=False,
+        ))
+    )
+
+
+def test_scalar_leaves_replicated_without_rules():
+    tree = {"a": jax.ShapeDtypeStruct((), np.float32),
+            "b": jax.ShapeDtypeStruct((1,), np.float32),
+            "w": jax.ShapeDtypeStruct((4, 8), np.float32)}
+    specs = ruleslib.match_partition_rules(
+        ((r"^w$", P(None, MODEL_AXIS)),), tree
+    )
+    assert specs["a"] == P()
+    assert specs["b"] == P()  # single element counts as scalar
+    assert specs["w"] == P(None, MODEL_AXIS)
+
+
+def test_unmatched_param_raises_naming_path():
+    tree = {"params": {"mystery_layer": {
+        "kernel_weights": jax.ShapeDtypeStruct((4, 4), np.float32)}}}
+    with pytest.raises(ValueError, match="params/mystery_layer/kernel_weights"):
+        ruleslib.match_partition_rules(((r"qkv/kernel$", P()),), tree)
+
+
+def test_rule_rank_mismatch_raises_naming_param():
+    tree = {"v": jax.ShapeDtypeStruct((4,), np.float32)}
+    with pytest.raises(ValueError, match="'v'"):
+        ruleslib.match_partition_rules(((r"v$", P(None, MODEL_AXIS)),), tree)
+
+
+def test_first_matching_rule_wins():
+    tree = {"w": jax.ShapeDtypeStruct((4, 8), np.float32)}
+    specs = ruleslib.match_partition_rules(
+        ((r"w$", P(MODEL_AXIS, None)), (r".*", P())), tree
+    )
+    assert specs["w"] == P(MODEL_AXIS, None)
+
+
+def test_builtin_rule_sets_cover_transformer():
+    shapes = _lm_shapes()
+    for name in ("transformer_tp", "transformer_fsdp"):
+        specs = ruleslib.match_partition_rules(
+            ruleslib.rule_set(name).rules, shapes
+        )
+        assert displib.plan_is_sharded(specs), name
+    tp = ruleslib.match_partition_rules(
+        ruleslib.rule_set("transformer_tp").rules, shapes
+    )
+    blk = tp["params"]["block_0"]
+    assert blk["MultiHeadSelfAttention_0"]["qkv"]["kernel"] == P(None, MODEL_AXIS)
+    assert blk["MultiHeadSelfAttention_0"]["proj"]["kernel"] == P(MODEL_AXIS, None)
+    assert blk["Dense_1"]["kernel"] == P(MODEL_AXIS, None)
+    assert blk["LayerNorm_0"]["scale"] == P()  # norms replicated
+
+
+def test_builtin_rule_sets_cover_resnet():
+    from fedml_tpu.models.resnet import resnet56
+
+    m = resnet56(class_num=10)
+    shapes = jax.eval_shape(
+        lambda: dict(m.init(
+            {"params": jax.random.key(0), "dropout": jax.random.key(0)},
+            jnp.zeros((1, 32, 32, 3), np.float32), train=False,
+        ))
+    )
+    specs = ruleslib.match_partition_rules(
+        ruleslib.rule_set("cnn_fsdp").rules, shapes
+    )
+    assert displib.plan_is_sharded(specs)
+    leaves = jax.tree_util.tree_leaves(
+        specs["batch_stats"], is_leaf=lambda x: isinstance(x, P)
+    )
+    assert all(s == P() for s in leaves)  # BN stats replicated
+
+
+def test_optimizer_state_matched_through_same_rules():
+    shapes = _lm_shapes()
+    rules = ruleslib.rule_set("transformer_fsdp").rules
+    param_specs = ruleslib.match_partition_rules(rules, shapes)
+
+    def opt_shapes(opt):
+        params = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), shapes["params"]
+        )
+        return jax.eval_shape(lambda: opt.init(params))
+
+    # SGD momentum: trace mirrors the param tree leaf for leaf
+    sgd_specs = ruleslib.match_partition_rules(
+        rules, opt_shapes(optax.sgd(0.1, momentum=0.9))
+    )
+    assert (sgd_specs[0].trace["block_0"]["Dense_0"]["kernel"]
+            == param_specs["params"]["block_0"]["Dense_0"]["kernel"])
+    # Adam: mu/nu shard like their params; the scalar step count replicates
+    adam_specs = ruleslib.match_partition_rules(
+        rules, opt_shapes(optax.adam(1e-3))
+    )
+    assert adam_specs[0].count == P()
+    assert (adam_specs[0].mu["head"]["kernel"]
+            == param_specs["params"]["head"]["kernel"])
+
+
+def test_unknown_rule_set_raises_listing_builtins():
+    with pytest.raises(ValueError, match="transformer_fsdp"):
+        ruleslib.rule_set("nope")
+
+
+# ---------------------------------------------------------------------------
+# mesh helpers
+# ---------------------------------------------------------------------------
+
+
+def test_shard_mesh_shapes_and_subset():
+    mesh = shard_mesh((2, 2))
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        CLIENT_AXIS: 2, MODEL_AXIS: 2,
+    }
+    # deterministic subset when the product is below the device count
+    mesh2 = shard_mesh((2, 2))
+    assert list(mesh.devices.flat) == list(mesh2.devices.flat)
+
+
+def test_shard_mesh_divisibility_error_names_both():
+    n = len(jax.devices())
+    with pytest.raises(ValueError, match=rf"(?s)requires 6 devices.*{n}"):
+        shard_mesh((3, 2))  # 6 does not divide 8
+    with pytest.raises(ValueError, match="16"):
+        shard_mesh((4, 4))  # more than available
+    with pytest.raises(ValueError, match="pair"):
+        shard_mesh((2, 2, 2))
+
+
+def test_named_sharding_validates_axis_names():
+    mesh = shard_mesh((2, 2))
+    s = named_sharding(mesh, P(CLIENT_AXIS, MODEL_AXIS))
+    assert s.mesh is mesh
+    with pytest.raises(ValueError, match="typo_axis"):
+        named_sharding(mesh, P("typo_axis"))
+
+
+def test_parse_mesh_shape():
+    assert parse_mesh_shape("2x4") == (2, 4)
+    assert parse_mesh_shape("1,8") == (1, 8)
+    assert parse_mesh_shape(None) is None
+    with pytest.raises(ValueError, match="CLIENTSxMODEL"):
+        parse_mesh_shape("abc")
+
+
+# ---------------------------------------------------------------------------
+# compile dispatcher
+# ---------------------------------------------------------------------------
+
+
+def test_dispatcher_picks_pjit_iff_sharded_spec_present():
+    mesh = shard_mesh((2, 2))
+
+    def f(x, y):
+        return x * jnp.sum(y)
+
+    mapped = displib.lower(
+        lambda x, y: (x * jnp.sum(y),), mesh=mesh,
+        in_specs=(P(CLIENT_AXIS), P()), out_specs=(P(CLIENT_AXIS),),
+    )
+    assert mapped.mode == "shard_map"
+    sharded = displib.lower(
+        f, mesh=mesh,
+        in_specs=(P(CLIENT_AXIS, MODEL_AXIS), P()),
+        out_specs=P(CLIENT_AXIS, MODEL_AXIS),
+    )
+    assert sharded.mode == "pjit"
+    # spec trees count too: one sharded leaf anywhere flips the mode
+    tree_specs = {"a": P(), "b": P(None, MODEL_AXIS)}
+    assert displib.plan_is_sharded(tree_specs)
+    assert not displib.plan_is_sharded({"a": P(), "b": P(CLIENT_AXIS)})
+
+
+def test_dispatcher_pjit_executes_and_honors_shardings():
+    mesh = shard_mesh((2, 2))
+    x = np.arange(16, dtype=np.float32).reshape(4, 4)
+    lowered = displib.lower(
+        lambda a: a * 2.0, mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS),), out_specs=P(None, MODEL_AXIS),
+    )
+    out = lowered(jax.device_put(x, named_sharding(mesh, P(None, MODEL_AXIS))))
+    np.testing.assert_array_equal(np.asarray(out), x * 2.0)
+    assert out.sharding.spec == P(None, MODEL_AXIS)
+
+
+def test_dispatcher_records_donation_on_both_modes():
+    mesh = shard_mesh((2, 2))
+    shard_args = dict(
+        in_specs=(P(None, MODEL_AXIS),), out_specs=P(None, MODEL_AXIS),
+        donate_argnums=(0,),
+    )
+    assert displib.lower(lambda a: a + 1, mesh=mesh,
+                         **shard_args).donate_argnums == (0,)
+    mapped = displib.lower(
+        lambda a: (a + 1,), mesh=mesh,
+        in_specs=(P(CLIENT_AXIS),), out_specs=(P(CLIENT_AXIS),),
+        donate_argnums=(0,),
+    )
+    assert mapped.mode == "shard_map"
+    assert mapped.donate_argnums == (0,)
+    # donated pjit args are consumed: the input buffer is deleted after
+    # the call wherever the backend implements donation; on CPU jax keeps
+    # it alive, so assert only that the call itself succeeds
+    lowered = displib.lower(lambda a: a * 3.0, mesh=mesh, **shard_args)
+    x = jax.device_put(np.ones((4, 4), np.float32),
+                       named_sharding(mesh, P(None, MODEL_AXIS)))
+    np.testing.assert_array_equal(np.asarray(lowered(x)), 3.0)
+
+
+# ---------------------------------------------------------------------------
+# sharded engine rounds
+# ---------------------------------------------------------------------------
+
+
+def _lm_problem(C=4, B=4, T=8, V=32, D=16, H=2, L=2, n_per=16, epochs=2):
+    rng = np.random.RandomState(0)
+    n = C * n_per
+    x = rng.randint(0, V, (n, T)).astype(np.int32)
+    y = rng.randint(0, V, (n, T)).astype(np.int32)
+    mask = np.ones((n, T), np.float32)
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(C)}
+    train = FederatedArrays({"x": x, "y": y, "mask": mask}, part)
+    test = {"x": x[:8], "y": y[:8], "mask": mask[:8]}
+    trainer = ClientTrainer(
+        module=TransformerLM(vocab_size=V, embed_dim=D, num_layers=L,
+                             num_heads=H, max_len=T),
+        task="nwp", optimizer=optax.sgd(0.1, momentum=0.9), epochs=epochs,
+    )
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=2, epochs=epochs, frequency_of_the_test=2, seed=0,
+    )
+    return trainer, train, test, cfg
+
+
+def _assert_trees(va, vb, exact=True):
+    for a, b in zip(jax.tree.leaves(va), jax.tree.leaves(vb)):
+        if exact:
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        else:
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("stage_on_device", [False, True])
+def test_fsdp_sharded_round_bit_identical(stage_on_device):
+    trainer, train, test, cfg = _lm_problem()
+    cfg = dataclasses.replace(cfg, stage_on_device=stage_on_device,
+                              straggler_frac=0.5)
+    sim = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_fsdp"))
+    assert sim._spmd and sim.shard_summary()["mode"] == "pjit"
+    v_s, h_s = sim.run()
+    v_u, h_u = FedSim(trainer, train, test, cfg,
+                      mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=True)
+    for rs, ru in zip(h_s, h_u):
+        for k, val in ru.items():
+            if k != "round_time":
+                assert rs[k] == val, (k, rs[k], val)
+
+
+def test_flagship_scan_geometry_bit_identical():
+    # one client at a time, the whole (1, 4) mesh given to its model —
+    # the big-model federated fine-tuning geometry
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    cfg = dataclasses.replace(cfg, cohort_execution="scan")
+    v_s, _ = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(1, 4), shard_rules="transformer_fsdp")).run()
+    v_u, _ = FedSim(trainer, train, test, cfg,
+                    mesh=client_mesh(jax.devices()[:1])).run()
+    _assert_trees(v_s, v_u, exact=True)
+
+
+def test_tp_sharded_round_allclose():
+    # true tensor parallelism: GSPMD partitions the matmuls, cross-shard
+    # reductions reassociate — allclose, not bitwise (docs/PERFORMANCE.md)
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    sim = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_tp"))
+    # TP threads the model axis into the module for boundary constraints
+    assert sim.trainer.module.mp_axis == MODEL_AXIS
+    v_s, _ = sim.run()
+    v_u, _ = FedSim(trainer, train, test, cfg,
+                    mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=False)
+
+
+def test_sharded_round_composes_with_robust_defense():
+    # the defense's clip-norm chain lives in two differently-fused
+    # programs (standalone agg dispatch vs in-round aggregation), so its
+    # reduce association is fusion luck — allclose, not bitwise; the same
+    # cross-program caveat packed lanes document for Train/Loss. The
+    # PLAIN aggregation tail stays bit-exact (tests above + shard_smoke).
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    cfg = dataclasses.replace(cfg, norm_bound=0.5, dp_stddev=0.0)
+    v_s, h_s = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_fsdp")).run()
+    v_u, h_u = FedSim(trainer, train, test, cfg,
+                      mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=False)
+    assert any(k.startswith("Robust/") for k in h_s[-1])
+
+
+def test_cnn_fsdp_sharded_round_executes_and_matches():
+    # conv models through the pjit path: gather_compute replicates the
+    # conv math (sidestepping the SPMD grouped-conv limitation the manual
+    # path exists for), so a (2, 2) mesh with a client axis > 1 must
+    # execute; BN batch-statistic reductions fuse differently across the
+    # two programs, so the match is allclose (~1 ULP), not bitwise —
+    # parallel/rules.py module note.
+    import flax.linen as nn
+
+    class TinyCNN(nn.Module):
+        @nn.compact
+        def __call__(self, x, train: bool = False):
+            x = nn.relu(nn.BatchNorm(use_running_average=not train)(
+                nn.Conv(8, (3, 3))(x)))
+            x = x.reshape((x.shape[0], -1))
+            return nn.Dense(4)(x)
+
+    C, B, n_per = 4, 4, 8
+    rng = np.random.RandomState(0)
+    n = C * n_per
+    x = rng.rand(n, 8, 8, 3).astype(np.float32)
+    y = rng.randint(0, 4, n).astype(np.int32)
+    part = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(C)}
+    train = FederatedArrays({"x": x, "y": y}, part)
+    trainer = ClientTrainer(module=TinyCNN(), optimizer=optax.sgd(0.1),
+                            epochs=1)
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=2, epochs=1, frequency_of_the_test=2, seed=0,
+    )
+    sim = FedSim(trainer, train, None, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="cnn_fsdp"))
+    assert sim._spmd
+    v_s, h_s = sim.run()
+    v_u, _ = FedSim(trainer, train, None, cfg,
+                    mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=False)
+    assert np.isfinite(h_s[-1]["Train/Loss"])
+
+
+def test_default_mesh_is_whole_device_model_axis():
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    sim = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, shard_rules="transformer_fsdp"))
+    assert dict(zip(sim.mesh.axis_names, sim.mesh.devices.shape)) == {
+        CLIENT_AXIS: 1, MODEL_AXIS: len(jax.devices()),
+    }
+
+
+def test_shard_summary_empty_without_rules():
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    assert FedSim(trainer, train, test, cfg).shard_summary() == {}
+
+
+def test_shard_rules_guards():
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    with pytest.raises(NotImplementedError, match="pack_lanes"):
+        FedSim(trainer, train, test, dataclasses.replace(
+            cfg, shard_rules="transformer_fsdp", pack_lanes=2))
+    with pytest.raises(ValueError, match="block_dispatch"):
+        FedSim(trainer, train, test, dataclasses.replace(
+            cfg, shard_rules="transformer_fsdp", block_dispatch=True))
+    with pytest.raises(ValueError, match="mesh"):
+        FedSim(trainer, train, test, dataclasses.replace(
+            cfg, mesh_shape=(2, 2)), mesh=client_mesh())
+    with pytest.raises(ValueError, match="model"):
+        # a mesh without a model axis cannot host a shard plan
+        FedSim(trainer, train, test, dataclasses.replace(
+            cfg, shard_rules="transformer_fsdp"), mesh=client_mesh())
+    from fedml_tpu.algorithms.decentralized import gossip_aggregator
+    from fedml_tpu.topology.topology import ring_topology
+
+    with pytest.raises(ValueError, match="per-client"):
+        FedSim(trainer, train, test, dataclasses.replace(
+            cfg, shard_rules="transformer_fsdp"),
+            aggregator=gossip_aggregator(ring_topology(4)))
+
+
+def test_shard_smoke_tool_runs():
+    """tools/shard_smoke.py is the tier-1 guard the docs point at — run it
+    in-process so the suite exercises exactly what it asserts."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "shard_smoke.py"
+    spec = importlib.util.spec_from_file_location("shard_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
