@@ -27,8 +27,10 @@ import jax.numpy as jnp
 from fedml_tpu.comm.base import BaseCommunicationManager
 from fedml_tpu.comm.managers import ClientManager, ServerManager
 from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
+from fedml_tpu.comm.send_pool import BroadcastSendError
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.obs import trace
 from fedml_tpu.sim.cohort import FederatedArrays, stack_cohort
 
 
@@ -83,21 +85,101 @@ class FedAvgDistAggregator:
         self._lock = threading.Lock()  # reference hazard fixed (SURVEY §5.2)
         self._acc: np.ndarray | None = None
         self._wsum = 0.0
+        self._excluded: list[int] = []  # workers dropped via exclude_worker
 
     def exclude_worker(self, index: int) -> None:
-        """Permanently stop expecting this worker (marked OFFLINE): later
-        rounds complete on the live set alone instead of re-waiting for the
+        """Stop expecting this worker (marked OFFLINE): later rounds
+        complete on the live set alone instead of re-waiting for the
         timeout every round. Only workers that have NOT uploaded this round
         can be excluded — a streaming tally cannot retract a folded
-        contribution (the timeout path only ever excludes missing workers)."""
+        contribution (the timeout path only ever excludes missing workers).
+        No longer a life sentence: :meth:`readmit_worker` reverses it when
+        the worker reappears."""
         with self._lock:
             if self.flag_client_model_uploaded_dict.get(index):
                 raise ValueError(
                     f"worker {index} already uploaded this round; a streaming "
                     "tally cannot retract a folded contribution"
                 )
-            self.flag_client_model_uploaded_dict.pop(index, None)
+            if self.flag_client_model_uploaded_dict.pop(index, None) is not None:
+                self._excluded.append(index)
             self.sample_num_dict.pop(index, None)
+
+    def readmit_worker(self, index: int) -> None:
+        """Inverse of :meth:`exclude_worker`, applied at a ROUND BOUNDARY
+        (the server defers readmission to round close — a mid-round
+        readmit would stall the all-received barrier until the returnee
+        uploads): the worker re-enters the expected set for later rounds."""
+        with self._lock:
+            if index in self.flag_client_model_uploaded_dict:
+                return  # already live
+            self.flag_client_model_uploaded_dict[index] = False
+            if index in self._excluded:
+                self._excluded.remove(index)
+
+    def excluded_workers(self) -> list[int]:
+        with self._lock:
+            return sorted(self._excluded)
+
+    def _empty_round_error(self) -> "EmptyRoundError":
+        """Diagnosable all-dropped-round error naming WHICH ranks were
+        missing and which were already OFFLINE-excluded (caller holds the
+        lock) — an all-dropped round must be debuggable from the log
+        alone."""
+        flags = self.flag_client_model_uploaded_dict
+        msg = (
+            "no worker uploads this round: all "
+            f"{len(flags)} live workers (ranks "
+            f"{sorted(i + 1 for i in flags)}) were dropped by the round "
+            "timeout"
+        )
+        if self._excluded:
+            msg += (f"; ranks {sorted(i + 1 for i in self._excluded)} "
+                    "already excluded as OFFLINE")
+        msg += ("; keeping the previous global model — nothing to "
+                "aggregate")
+        return EmptyRoundError(msg)
+
+    # -- crash-recovery snapshot (docs/ROBUSTNESS.md "Failure recovery") -----
+
+    def snapshot_state(self) -> dict:
+        """Round-close tally snapshot for the server checkpoint: np.ndarray
+        values plus JSON-safe scalars (obs.checkpoint.RoundCheckpointer.
+        save_server splits them). Saved at round close, when the streaming
+        accumulator is empty; mid-round acc/wsum are included anyway so a
+        future mid-round snapshotter inherits them for free."""
+        with self._lock:
+            out: dict = {
+                "wsum": float(self._wsum),
+                "live": sorted(self.flag_client_model_uploaded_dict),
+                "uploaded": sorted(
+                    i for i, f in self.flag_client_model_uploaded_dict.items()
+                    if f
+                ),
+                "excluded": sorted(self._excluded),
+                "sample_num": {str(i): float(v)
+                               for i, v in self.sample_num_dict.items()},
+            }
+            if self._acc is not None:
+                out["acc"] = np.array(self._acc)
+            return out
+
+    def restore_state(self, state: dict) -> None:
+        with self._lock:
+            self._wsum = float(state.get("wsum", 0.0))
+            acc = state.get("acc")
+            self._acc = None if acc is None else np.asarray(acc, np.float64)
+            live = state.get("live")
+            if live is not None:
+                uploaded = {int(i) for i in state.get("uploaded", [])}
+                self.flag_client_model_uploaded_dict = {
+                    int(i): int(i) in uploaded for i in live
+                }
+            self._excluded = [int(i) for i in state.get("excluded", [])]
+            self.sample_num_dict = {
+                int(i): float(v)
+                for i, v in state.get("sample_num", {}).items()
+            }
 
     def live_workers(self) -> list[int]:
         with self._lock:
@@ -152,12 +234,7 @@ class FedAvgDistAggregator:
         with self._lock:
             flags = self.flag_client_model_uploaded_dict
             if not any(flags.values()):
-                raise EmptyRoundError(
-                    "no worker uploads this round (all "
-                    f"{len(flags)} live workers dropped by the round "
-                    "timeout); keeping the previous global model — nothing "
-                    "to aggregate"
-                )
+                raise self._empty_round_error()
             out = self._finish()
             for i in flags:
                 flags[i] = False
@@ -190,14 +267,9 @@ class BufferedFedAvgDistAggregator(FedAvgDistAggregator):
 
     def aggregate(self) -> np.ndarray:
         with self._lock:
-            flags = self.flag_client_model_uploaded_dict
             if not self.model_dict:
-                raise EmptyRoundError(
-                    "no worker uploads this round (all "
-                    f"{len(flags)} live workers dropped by the round "
-                    "timeout); keeping the previous global model — nothing "
-                    "to aggregate"
-                )
+                raise self._empty_round_error()
+            flags = self.flag_client_model_uploaded_dict
             for i, payload in self.model_dict.items():
                 self._fold(payload, self.sample_num_dict[i])
             self.model_dict.clear()
@@ -217,7 +289,11 @@ class FedAvgServerManager(ServerManager):
                  exclude_after: int = 2,
                  on_round_done: Callable[[int, np.ndarray], None] | None = None,
                  use_broadcast: bool = True,
-                 buffered_aggregation: bool = False):
+                 buffered_aggregation: bool = False,
+                 heartbeat_timeout: float | None = None,
+                 readmission: bool = False,
+                 checkpointer=None,
+                 checkpoint_every: int = 1):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
         self.round_num = round_num
@@ -241,10 +317,25 @@ class FedAvgServerManager(ServerManager):
         # client hangs the round forever, mpi com_manager has no recovery)
         self.round_timeout = round_timeout
         # a worker missing this many CONSECUTIVE timed-out rounds is
-        # permanently excluded (single misses — e.g. round-0 compile skew —
-        # only drop it from that round's aggregate)
+        # excluded (single misses — e.g. round-0 compile skew — only drop
+        # it from that round's aggregate); with readmission enabled an
+        # excluded worker that re-contacts the server rejoins later cohorts
         self.exclude_after = exclude_after
         self._miss_counts: dict[int, int] = {}
+        # liveness plane (docs/ROBUSTNESS.md "Failure recovery"): a worker
+        # missing at the round timeout but heard from (heartbeat/status)
+        # within heartbeat_timeout seconds is SLOW — alive, dropped from
+        # this round, but not marched toward exclusion. readmission=True
+        # additionally parks excluded workers instead of telling them to
+        # stop, and re-enters them into later cohorts on contact.
+        self.heartbeat_timeout = heartbeat_timeout
+        self.readmission = bool(readmission)
+        self._pending_readmit: set[int] = set()
+        # crash recovery: a RoundCheckpointer (obs/checkpoint.py) given
+        # here snapshots the full server round state every
+        # checkpoint_every closes; restore_from_checkpoint() resumes
+        self.checkpointer = checkpointer
+        self.checkpoint_every = max(1, int(checkpoint_every))
         from fedml_tpu.comm.status import ClientStatusTracker
 
         self.status = ClientStatusTracker(worker_num)
@@ -306,8 +397,13 @@ class FedAvgServerManager(ServerManager):
                                    self.model_desc)
                 if finished:
                     msg.add_params("finished", 1)
-                self.broadcast_message(msg, group, per_receiver=per_receiver)
+                try:
+                    self.broadcast_message(msg, group,
+                                           per_receiver=per_receiver)
+                except BroadcastSendError as e:
+                    self._downlink_failed(e.errors)
             else:
+                errors: dict[int, BaseException] = {}
                 for w in group:
                     msg = Message(msg_type, 0, w)
                     msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
@@ -322,10 +418,39 @@ class FedAvgServerManager(ServerManager):
                     if per_receiver is not None:
                         for k, v in per_receiver[w].items():
                             msg.add_params(k, v)
-                    self.send_message(msg)
+                    try:
+                        self.send_message(msg)
+                    except Exception as e:
+                        if getattr(e, "unretryable", False):
+                            raise  # injected crash: process death, not a leg
+                        errors[w] = e
+                if errors:
+                    self._downlink_failed(errors)
+
+    def _downlink_failed(self, errors: dict[int, BaseException]) -> None:
+        """Per-destination fan-out failures are NOT fatal to the round
+        protocol: the affected ranks simply miss this sync and the elastic
+        round timeout / liveness plane accounts for their missing uploads.
+        Injected crashes (``unretryable``) re-raise — they simulate THIS
+        process dying, not a peer being unreachable."""
+        for e in errors.values():
+            if getattr(e, "unretryable", False):
+                raise e
+        logging.warning(
+            "downlink fan-out failed to ranks %s (continuing: the round "
+            "timeout / liveness plane covers their missing uploads): %s",
+            sorted(errors),
+            "; ".join(f"{d}: {type(e).__name__}: {e}"
+                      for d, e in sorted(errors.items())),
+        )
 
     def send_init_msg(self) -> None:
-        cohort = rnglib.sample_clients(0, self.client_num_in_total, self.worker_num)
+        # cohort keyed by round_idx (not literal 0) so a server restarted
+        # from a checkpoint re-broadcasts ITS round — clients train as that
+        # round (authoritative round-index sync) and resume is idempotent
+        cohort = rnglib.sample_clients(self.round_idx,
+                                       self.client_num_in_total,
+                                       self.worker_num)
         self._fanout_model(
             MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
             [w + 1 for w in range(self.worker_num)],
@@ -333,9 +458,36 @@ class FedAvgServerManager(ServerManager):
         )
 
     def register_message_receive_handlers(self) -> None:
+        from fedml_tpu.comm.status import ClientStatus
+
         self.register_message_receive_handler(
             MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_model_from_client
         )
+        self.register_message_receive_handler(
+            ClientStatus.MSG_TYPE_CLIENT_STATUS, self._on_client_status
+        )
+
+    def _on_client_status(self, msg: Message) -> None:
+        """Heartbeat/status contact: refresh the liveness table, reset the
+        consecutive-miss count (the worker is provably alive), and — when
+        readmission is on — queue an excluded worker's return for the next
+        round boundary."""
+        from fedml_tpu.comm.status import ClientStatus
+
+        sender = msg.get_sender_id()
+        status = msg.get(ClientStatus.KEY_STATUS)
+        with self._round_lock:
+            self.status.update(sender, status)
+            if status == ClientStatus.ONLINE:
+                self._miss_counts.pop(sender - 1, None)
+                if self.readmission and not self.aggregator.is_live(sender - 1):
+                    if sender - 1 not in self._pending_readmit:
+                        logging.info(
+                            "excluded worker %d reappeared (status contact); "
+                            "queueing readmission at the next round close",
+                            sender,
+                        )
+                    self._pending_readmit.add(sender - 1)
 
     def _on_model_from_client(self, msg: Message) -> None:
         sender = msg.get_sender_id()
@@ -350,9 +502,24 @@ class FedAvgServerManager(ServerManager):
         with self._round_lock:
             current = self.round_idx
             if not self.aggregator.is_live(sender - 1):
-                # excluded (OFFLINE) worker resurfaced: stays excluded (and
-                # stays OFFLINE in the status table)
-                logging.info("ignoring upload from excluded worker %d", sender)
+                if self.readmission:
+                    # excluded worker resurfaced WITH an upload: provably
+                    # alive — queue readmission at the next round boundary
+                    # (this round's tally cannot absorb it; first-wins and
+                    # the round-index guard make the replayed leg safe)
+                    self.status.update(sender, ClientStatus.ONLINE)
+                    self._miss_counts.pop(sender - 1, None)
+                    if sender - 1 not in self._pending_readmit:
+                        logging.info(
+                            "excluded worker %d reappeared (upload for round "
+                            "%s); queueing readmission", sender, upload_round,
+                        )
+                    self._pending_readmit.add(sender - 1)
+                else:
+                    # readmission off: stays excluded (and stays OFFLINE in
+                    # the status table)
+                    logging.info("ignoring upload from excluded worker %d",
+                                 sender)
                 return
             if upload_round is not None and int(upload_round) != current:
                 # a straggler's upload from a timed-out round: one-round-stale
@@ -395,28 +562,45 @@ class FedAvgServerManager(ServerManager):
             # tallied and excluded
             missing = sorted(set(self.aggregator.live_workers()) - set(got))
             excluded = []
+            slow = []
             for w in missing:
+                if (self.heartbeat_timeout is not None
+                        and self.status.seen_within(w + 1,
+                                                    self.heartbeat_timeout)):
+                    # heartbeat fresh: the worker is SLOW, not dead — it
+                    # misses this round's aggregate but accrues no
+                    # exclusion miss (its heartbeats keep proving liveness)
+                    self.status.update(w + 1, ClientStatus.SLOW, touch=False)
+                    slow.append(w + 1)
+                    continue
                 self._miss_counts[w] = self._miss_counts.get(w, 0) + 1
                 if self._miss_counts[w] >= self.exclude_after:
-                    # consecutive misses: presumed dead — stop expecting it
-                    # so later rounds complete without another timeout
-                    self.status.update(w + 1, ClientStatus.OFFLINE)
+                    # consecutive silent misses: presumed dead — stop
+                    # expecting it so later rounds complete without another
+                    # timeout (readmission re-enters it if it reappears)
+                    self.status.update(w + 1, ClientStatus.OFFLINE,
+                                       touch=False)
                     self.aggregator.exclude_worker(w)
                     excluded.append(w + 1)
         logging.warning(
             "round %d timed out: aggregating %d/%d workers, dropping %s"
-            "%s (weights renormalized)",
+            "%s%s (weights renormalized)",
             expected_round, len(got), self.worker_num,
             [w + 1 for w in missing],
+            f", slow (heartbeat fresh) {slow}" if slow else "",
             f", excluding {excluded} as OFFLINE" if excluded else "",
         )
-        # tell the excluded clients to stop: they would otherwise keep
-        # training models the server discards every round
-        self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
-                           excluded, finished=True)
+        if excluded and not self.readmission:
+            # tell the excluded clients to stop: they would otherwise keep
+            # training models the server discards every round. With
+            # readmission on they are PARKED instead — still heartbeating,
+            # eligible to rejoin later cohorts on contact.
+            self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                               excluded, finished=True)
         self._complete_round(expected_round)
 
     def _complete_round(self, expected_round: int) -> None:
+        readmitted: list[int] = []
         with self._round_lock:
             if self.round_idx != expected_round:
                 return  # a concurrent close won the race for this round
@@ -427,6 +611,29 @@ class FedAvgServerManager(ServerManager):
                 self._round_timer = None
             self.global_flat = self.aggregator.aggregate()
             self.round_idx += 1
+            # readmission boundary: workers that re-contacted the server
+            # while excluded re-enter the expected set HERE, never
+            # mid-round (a mid-round readmit would stall the all-received
+            # barrier until the returnee uploads)
+            if self._pending_readmit:
+                from fedml_tpu.comm.status import ClientStatus
+
+                for w in sorted(self._pending_readmit):
+                    self.aggregator.readmit_worker(w)
+                    self._miss_counts.pop(w, None)
+                    self.status.update(w + 1, ClientStatus.ONLINE,
+                                       touch=False)
+                    readmitted.append(w + 1)
+                self._pending_readmit.clear()
+            # snapshot under the lock (consistent round state), write the
+            # files OUTSIDE it — full-model disk I/O must not block the
+            # upload/heartbeat handlers queued on _round_lock
+            ckpt_state = self._checkpoint_state()
+        if ckpt_state is not None:
+            self._write_checkpoint(ckpt_state)
+        if readmitted:
+            logging.info("readmitted workers %s into round %d's cohort",
+                         readmitted, self.round_idx)
         if self.on_round_done:
             self.on_round_done(expected_round, self.global_flat)
         if self.round_idx >= self.round_num:
@@ -440,6 +647,62 @@ class FedAvgServerManager(ServerManager):
         self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                            [w + 1 for w in self.aggregator.live_workers()],
                            cohort=cohort)
+
+    # -- crash recovery (docs/ROBUSTNESS.md "Failure recovery") --------------
+
+    def _checkpoint_state(self) -> dict | None:
+        """Snapshot the full server round state at round close (caller
+        holds ``_round_lock``) — everything a restarted server needs to
+        re-broadcast ``round_idx`` and continue bit-identically: the new
+        global flat model, the round index, miss counts, the status table,
+        and the aggregator's tally/defense state (robust noise-key round
+        included). The snapshot is taken under the lock; the disk write
+        (:meth:`_write_checkpoint`) runs after it is released."""
+        if self.checkpointer is None or (self.round_idx % self.checkpoint_every):
+            return None
+        return {
+            "round_idx": int(self.round_idx),
+            "global_flat": np.asarray(self.global_flat),
+            "miss_counts": {str(k): int(v)
+                            for k, v in self._miss_counts.items()},
+            "status": self.status.snapshot(),
+            "aggregator": self.aggregator.snapshot_state(),
+        }
+
+    def _write_checkpoint(self, state: dict) -> None:
+        """Persist a :meth:`_checkpoint_state` snapshot. Runs BEFORE the
+        round callback and the next fan-out, so a crash during either
+        resumes from this round — and the authoritative-round-index sync
+        makes the replayed fan-out idempotent."""
+        with trace.span("ft/checkpoint", round=state["round_idx"]):
+            self.checkpointer.save_server(state["round_idx"], state)
+
+    def restore_from_checkpoint(self, checkpointer=None,
+                                round_idx: int | None = None) -> int:
+        """Load a server snapshot (latest by default) and arrange to resume
+        AS that round: the next ``send_init_msg`` re-broadcasts the
+        checkpointed round index and global model, clients re-train as that
+        round, and the run continues bit-identically to one that never
+        crashed (tools/ft_smoke.py holds the contract). Returns the resumed
+        round index."""
+        ckptr = checkpointer or self.checkpointer
+        if ckptr is None:
+            raise ValueError("restore_from_checkpoint needs a checkpointer")
+        state = ckptr.restore_server(round_idx)
+        with self._round_lock:
+            self.round_idx = int(state["round_idx"])
+            self.global_flat = np.asarray(state["global_flat"], np.uint8)
+            self._miss_counts = {
+                int(k): int(v)
+                for k, v in state.get("miss_counts", {}).items()
+            }
+            for cid, st in state.get("status", {}).items():
+                self.status.update(int(cid), st, touch=False)
+            self.aggregator.restore_state(state.get("aggregator", {}))
+        logging.info("restored server round state: resuming as round %d "
+                     "(live workers %s)", self.round_idx,
+                     [w + 1 for w in self.aggregator.live_workers()])
+        return self.round_idx
 
 
 class FedAvgClientManager(ClientManager):
@@ -686,13 +949,24 @@ def init_template(trainer: ClientTrainer, train_arrays: dict, batch_size: int,
 def run_manager_protocol(server, clients, join_timeout: float = 30.0) -> None:
     """Shared run harness: client managers in daemon threads, the server's
     receive loop on the caller thread, graceful join. Used by distributed
-    FedAvg, TurboAggregate, and cross-silo."""
+    FedAvg, TurboAggregate, and cross-silo. If the server's loop dies (e.g.
+    an injected crash, comm/faults.py), the client transports are stopped
+    so their threads unblock before the error propagates — a crashed server
+    must not leak parked client threads into the next (restarted) run."""
     threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
     for t in threads:
         t.start()
     server.register_message_receive_handlers()
     server.send_init_msg()
-    server.comm.handle_receive_message()  # blocks until the protocol finishes
+    try:
+        server.comm.handle_receive_message()  # blocks until the protocol finishes
+    except BaseException:
+        for c in clients:
+            try:
+                c.comm.stop_receive_message()
+            except Exception:  # noqa: BLE001 — best-effort unblock
+                pass
+        raise
     for t in threads:
         t.join(timeout=join_timeout)
 
@@ -718,6 +992,13 @@ def run_distributed_fedavg(
     robust_stats: dict | None = None,
     fault_specs=None,
     fault_seed: int = 0,
+    retry_policy=None,
+    heartbeat_interval: float | None = None,
+    heartbeat_timeout: float | None = None,
+    readmission: bool | None = None,
+    checkpoint_dir=None,
+    checkpoint_every: int = 1,
+    resume: bool = False,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -735,6 +1016,19 @@ def run_distributed_fedavg(
     (``robust_stats`` receives per-round Robust/* records).
     ``fault_specs`` (comm/faults.py: a {rank: FaultSpec} map or a spec
     string) wraps every rank's transport in the seeded fault injector.
+
+    Fault-tolerance knobs (docs/ROBUSTNESS.md "Failure recovery"):
+    ``retry_policy`` (comm/retry.py) arms retry/backoff on every rank's
+    send plane, OUTSIDE any fault wrapper so each attempt re-rolls its
+    faults; ``heartbeat_interval`` starts a per-client heartbeat thread
+    (and defaults ``heartbeat_timeout`` to 3x the interval, the server's
+    slow-vs-dead window); ``readmission`` (default: on iff heartbeats are
+    on) lets an OFFLINE-excluded worker rejoin later cohorts when it
+    re-contacts the server. ``checkpoint_dir`` snapshots the full server
+    round state every ``checkpoint_every`` round closes; ``resume=True``
+    restores the latest snapshot and re-broadcasts its round — clients
+    re-train AS that round, so a crashed-and-restarted run is
+    bit-identical to an uninterrupted one (tools/ft_smoke.py).
     Returns the final global variables."""
     if codec is not None and (server_cls is not None
                               or client_cls_for_rank is not None):
@@ -754,6 +1048,33 @@ def run_distributed_fedavg(
         from fedml_tpu.comm.faults import wrap_make_comm
 
         make_comm = wrap_make_comm(make_comm, fault_specs, seed=fault_seed)
+    if retry_policy is not None:
+        # armed on the OUTERMOST manager (fault wrappers included): each
+        # retry attempt re-runs the full send path with fresh fault draws
+        def make_comm(rank: int, _inner=make_comm):
+            mgr = _inner(rank)
+            mgr.retry_policy = retry_policy
+            return mgr
+
+    if readmission is None:
+        readmission = heartbeat_interval is not None
+    if heartbeat_interval is not None and heartbeat_timeout is None:
+        heartbeat_timeout = 3.0 * heartbeat_interval
+    ckptr = None
+    ft_kwargs: dict = {}
+    if heartbeat_timeout is not None:
+        ft_kwargs["heartbeat_timeout"] = heartbeat_timeout
+    if readmission:
+        ft_kwargs["readmission"] = True
+    if checkpoint_dir is not None:
+        from fedml_tpu.obs.checkpoint import RoundCheckpointer
+
+        ckptr = RoundCheckpointer(checkpoint_dir)
+        ft_kwargs["checkpointer"] = ckptr
+        ft_kwargs["checkpoint_every"] = checkpoint_every
+    if ft_kwargs:
+        # explicit caller server_kwargs still win over the derived knobs
+        server_kwargs = {**ft_kwargs, **(server_kwargs or {})}
     template, flat, desc = init_template(trainer, train_data.arrays, batch_size,
                                          seed, init_overrides=init_overrides)
     if robust_config is not None:
@@ -799,6 +1120,19 @@ def run_distributed_fedavg(
         on_round_done=_done,
         **(server_kwargs or {}),
     )
+    if resume:
+        if ckptr is None:
+            raise ValueError("resume=True requires checkpoint_dir")
+        if ckptr.latest_server_round() is not None:
+            server.restore_from_checkpoint()
+            if server.round_idx >= round_num:
+                # every round already closed before the crash: nothing to
+                # re-run — the checkpointed global IS the final model
+                server.comm.stop_receive_message()
+                return unpack_pytree(server.global_flat, desc)
+        else:
+            logging.info("resume requested but no server checkpoint under "
+                         "%s; starting fresh", checkpoint_dir)
     cls_for = client_cls_for_rank or (lambda r: FedAvgClientManager)
     clients = [
         cls_for(r)(
@@ -808,9 +1142,31 @@ def run_distributed_fedavg(
         for r in range(1, worker_num + 1)
     ]
 
-    run_manager_protocol(server, clients)
-    if codec is not None and comm_stats is not None:
-        comm_stats["totals"] = server.accountant.totals()
+    from fedml_tpu.comm.retry import retry_stats
+
+    retries_before = retry_stats()["retries"]
+    heartbeats = []
+    if heartbeat_interval is not None:
+        from fedml_tpu.comm.status import HeartbeatSender
+
+        heartbeats = [
+            HeartbeatSender(c.comm, c.rank, heartbeat_interval).start()
+            for c in clients
+        ]
+    try:
+        run_manager_protocol(server, clients)
+    finally:
+        for hb in heartbeats:
+            hb.stop()
+    if comm_stats is not None:
+        if codec is not None:
+            comm_stats["totals"] = server.accountant.totals()
+        if retry_policy is not None:
+            from fedml_tpu.obs import metrics as metricslib
+
+            comm_stats.setdefault("totals", {})[metricslib.COMM_RETRY_COUNT] = (
+                retry_stats()["retries"] - retries_before
+            )
     return unpack_pytree(results["final"], desc)
 
 

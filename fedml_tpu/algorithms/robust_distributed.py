@@ -277,6 +277,33 @@ class RobustDistAggregator(FedAvgDistAggregator):
         idx = int(krum_select({"w": jnp.asarray(kstack)}, cfg.num_byzantine))
         return stack[idx], k - 1
 
+    # -- crash-recovery snapshot ---------------------------------------------
+
+    def snapshot_state(self) -> dict:
+        """Base tally snapshot plus the defense's round schedule: the noise
+        -key round counter (a restarted server must NOT replay round k's
+        noise for round k+1) and the reservoir (empty at round close, when
+        the server checkpoints; carried anyway). Called at round close
+        under the server's round lock — no concurrent folds."""
+        out = super().snapshot_state()
+        out["robust_round"] = int(self._round_counter)
+        out["res_seen"] = int(self._res_seen)
+        if self._reservoir:
+            out["reservoir"] = np.stack(self._reservoir)
+        return out
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self._round_counter = int(state.get("robust_round", 0))
+        self._res_seen = int(state.get("res_seen", 0))
+        res = state.get("reservoir")
+        self._reservoir = (
+            [np.array(r, np.float32) for r in res] if res is not None else []
+        )
+        # round-close rng state is exactly "fresh for the current round
+        # counter" — the same state _finish() leaves behind
+        self._res_rng = _reservoir_rng(self.config, self._round_counter)
+
     def pop_round_stats(self) -> dict | None:
         """The closed round's Robust/* record (None when no round closed
         since the last pop) — the server manager flushes it into the

@@ -25,6 +25,7 @@ from fedml_tpu.obs import trace
 
 if TYPE_CHECKING:
     from fedml_tpu.comm.message import FramedMessage, Message
+    from fedml_tpu.comm.retry import RetryPolicy
     from fedml_tpu.comm.send_pool import SendWorkerPool
 
 
@@ -34,9 +35,18 @@ class Observer(abc.ABC):
 
 
 class BaseCommunicationManager(abc.ABC):
-    def __init__(self, send_pool: "SendWorkerPool | None" = None):
+    def __init__(self, send_pool: "SendWorkerPool | None" = None,
+                 retry_policy: "RetryPolicy | None" = None):
         self._observers: list[Observer] = []
         self._send_pool = send_pool
+        # retry/backoff send plane (docs/ROBUSTNESS.md "Failure recovery"):
+        # when set, every broadcast leg (and the manager-layer unary send)
+        # is re-attempted under the policy instead of failing the protocol
+        # on the first transient transport error. Settable post-construction
+        # (``mgr.retry_policy = policy``) so run harnesses can arm it on any
+        # backend — including a fault-injection wrapper, whose seeded draws
+        # then re-roll per attempt.
+        self.retry_policy = retry_policy
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -70,8 +80,14 @@ class BaseCommunicationManager(abc.ABC):
         client index); array overrides are rejected by the frame.
 
         With a send pool installed the per-receiver sends run concurrently
-        and this call returns after all of them completed (first error
-        re-raised) — downlink wall time is the slowest leg, not the sum.
+        and this call returns after all of them completed — downlink wall
+        time is the slowest leg, not the sum.
+
+        Failure handling is per-destination isolated: each leg runs under
+        ``retry_policy`` (when set), one dead receiver never aborts or
+        masks the other legs, and all exhausted legs are reported together
+        as a :class:`~fedml_tpu.comm.send_pool.BroadcastSendError` naming
+        the destination ranks.
         """
         frame = msg.frame()
         frame.tail_bytes()  # join the shared payload ONCE, before pooled
@@ -81,14 +97,29 @@ class BaseCommunicationManager(abc.ABC):
 
         def send_one(dst: int) -> None:
             ov = per_receiver.get(dst) if per_receiver else None
+            policy = self.retry_policy
             with trace.span("comm/send", msg_type=msg_type, sender=sender,
                             receiver=dst, bytes=nbytes, broadcast=1):
-                self._send_framed(frame, dst, ov)
+                if policy is None:
+                    self._send_framed(frame, dst, ov)
+                else:
+                    policy.run(partial(self._send_framed, frame, dst, ov),
+                               dst=dst, msg_type=msg_type)
 
         pool = self._send_pool
         if pool is None:
+            errors: dict[int, BaseException] = {}
             for dst in receiver_ids:
-                send_one(dst)
+                try:
+                    send_one(dst)
+                except Exception as e:
+                    if getattr(e, "unretryable", False):
+                        raise  # an injected crash is process death, not a leg
+                    errors[dst] = e
+            if errors:
+                from fedml_tpu.comm.send_pool import BroadcastSendError
+
+                raise BroadcastSendError(errors)
         else:
             pool.run_all([(dst, partial(send_one, dst)) for dst in receiver_ids])
 

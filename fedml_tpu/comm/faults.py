@@ -18,6 +18,19 @@ Faults (all seeded — a given (seed, rank, message order) replays exactly):
                   arrive after the round timeout (the stale-upload path)
 - ``dup=p``       send the message twice (duplicate first-wins path)
 - ``corrupt=p``   flip bytes in the model payload (clip/reject defense path)
+- ``fail=p``      the send RAISES :class:`TransientSendError` instead of
+                  delivering — the retry/backoff plane's test surface
+                  (comm/retry.py); each retry attempt re-rolls the draw
+- ``recv_drop=p``     lose an ARRIVING message with probability p (downlink
+                      loss as seen by the wrapped rank — uplink injection
+                      alone cannot exercise receive-side recovery)
+- ``recv_delay=s[@p]`` deliver an arriving message s seconds late on a
+                      timer thread (receive-side reordering)
+- ``crash=r``     raise :class:`InjectedCrash` on the first send carrying a
+                  round index >= r — simulates the process dying mid-run;
+                  never retried, never isolated to one broadcast leg
+                  (tools/ft_smoke.py kills the server with it and restarts
+                  from the round checkpoint)
 
 Spec string (the ``--fault_spec`` CLI syntax): ``;``-separated per-rank
 entries, ``<rank|*>:<fault>=<val>[,<fault>=<val>...]`` — e.g.
@@ -45,12 +58,33 @@ from fedml_tpu.obs import trace
 _CORRUPTIBLE = (Message.MSG_ARG_KEY_MODEL_PARAMS,
                 Message.MSG_ARG_KEY_ENCODED_UPDATE)
 
+# fedavg_distributed.MyMessage.MSG_ARG_KEY_ROUND_IDX — the authoritative
+# round index every sync/upload carries since PR 6. Spelled out here so the
+# comm layer does not import the algorithm layer.
+_ROUND_IDX_KEY = "round_idx"
+
+
+class TransientSendError(ConnectionError):
+    """Injected send failure (``fail=p``): the transport 'lost the
+    connection' for this attempt. The retry plane (comm/retry.py) is
+    expected to recover it; without retries it fails the leg."""
+
+
+class InjectedCrash(RuntimeError):
+    """Injected process death (``crash=r``): the wrapped rank 'dies' when
+    it first touches round ``r``. Marked unretryable so the retry plane
+    propagates it immediately, and re-raised out of per-leg broadcast
+    isolation — a crash must kill the protocol loop, that is the point."""
+
+    unretryable = True
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    """One rank's fault profile. Probabilities in [0, 1]; ``delay`` in
-    seconds; ``corrupt_frac`` is the fraction of payload bytes flipped per
-    corrupted message."""
+    """One rank's fault profile. Probabilities in [0, 1]; ``delay``/
+    ``recv_delay`` in seconds; ``corrupt_frac`` is the fraction of payload
+    bytes flipped per corrupted message; ``crash_round`` < 0 disables the
+    crash."""
 
     drop: float = 0.0
     delay: float = 0.0
@@ -58,19 +92,33 @@ class FaultSpec:
     dup: float = 0.0
     corrupt: float = 0.0
     corrupt_frac: float = 0.01
+    fail: float = 0.0
+    recv_drop: float = 0.0
+    recv_delay: float = 0.0
+    recv_delay_prob: float = 1.0
+    crash_round: int = -1
 
     def __post_init__(self):
-        for name in ("drop", "delay_prob", "dup", "corrupt"):
+        for name in ("drop", "delay_prob", "dup", "corrupt", "fail",
+                     "recv_drop", "recv_delay_prob"):
             v = getattr(self, name)
             if not 0.0 <= v <= 1.0:
                 raise ValueError(f"FaultSpec.{name}={v} must be in [0, 1]")
-        if self.delay < 0:
-            raise ValueError(f"FaultSpec.delay={self.delay} must be >= 0")
+        for name in ("delay", "recv_delay"):
+            if getattr(self, name) < 0:
+                raise ValueError(f"FaultSpec.{name} must be >= 0")
 
     @property
     def active(self) -> bool:
         return (self.drop > 0 or self.dup > 0 or self.corrupt > 0
-                or (self.delay > 0 and self.delay_prob > 0))
+                or self.fail > 0 or self.crash_round >= 0
+                or (self.delay > 0 and self.delay_prob > 0)
+                or self.recv_active)
+
+    @property
+    def recv_active(self) -> bool:
+        return (self.recv_drop > 0
+                or (self.recv_delay > 0 and self.recv_delay_prob > 0))
 
 
 def parse_fault_spec(spec: str) -> dict:
@@ -95,17 +143,21 @@ def parse_fault_spec(spec: str) -> dict:
             if not sep:
                 raise ValueError(f"fault {f!r}: expected '<name>=<value>'")
             name = name.strip()
-            if name == "delay":
+            if name in ("delay", "recv_delay"):
                 secs, at, prob = val.partition("@")
-                kw["delay"] = float(secs)
+                kw[name] = float(secs)
                 if at:
-                    kw["delay_prob"] = float(prob)
-            elif name in ("drop", "dup", "corrupt", "corrupt_frac"):
+                    kw[f"{name}_prob"] = float(prob)
+            elif name == "crash":
+                kw["crash_round"] = int(val)
+            elif name in ("drop", "dup", "corrupt", "corrupt_frac", "fail",
+                          "recv_drop"):
                 kw[name] = float(val)
             else:
                 raise ValueError(
                     f"unknown fault {name!r} (expected drop | delay | dup | "
-                    "corrupt | corrupt_frac)"
+                    "corrupt | corrupt_frac | fail | recv_drop | recv_delay "
+                    "| crash)"
                 )
         out[key] = FaultSpec(**kw)
     if not out:
@@ -129,16 +181,28 @@ class FaultyCommManager(BaseCommunicationManager):
         self.spec = spec
         self.rank = rank
         self._rng = np.random.RandomState((seed * 9176 + rank * 131) % (2**31))
+        # independent stream for the receive side so adding downlink faults
+        # never shifts an existing seeded send-side schedule
+        self._recv_rng = np.random.RandomState(
+            (seed * 9176 + rank * 131 + 0x5EC5) % (2**31)
+        )
         self._rng_lock = threading.Lock()
         self.applied: list[tuple[str, int, int]] = []
+        self._shims: dict[object, "_RecvFaultShim"] = {}
+        self._crashed = False
 
-    # -- receive side: pure delegation ---------------------------------------
+    # -- receive side: delegation, optionally through the fault shim ---------
 
     def add_observer(self, observer) -> None:
-        self.inner.add_observer(observer)
+        if not self.spec.recv_active:
+            self.inner.add_observer(observer)
+            return
+        shim = _RecvFaultShim(self, observer)
+        self._shims[observer] = shim
+        self.inner.add_observer(shim)
 
     def remove_observer(self, observer) -> None:
-        self.inner.remove_observer(observer)
+        self.inner.remove_observer(self._shims.pop(observer, observer))
 
     def handle_receive_message(self) -> None:
         self.inner.handle_receive_message()
@@ -150,7 +214,9 @@ class FaultyCommManager(BaseCommunicationManager):
 
     def _decide(self, msg_type: int, receiver: int) -> dict:
         """One seeded draw per enabled fault kind (fixed draw pattern per
-        message — outcomes never shift the sequence, so a run replays)."""
+        message — outcomes never shift the sequence, so a run replays).
+        The ``fail`` draw comes LAST so enabling it never shifts the draws
+        of a pre-existing seeded schedule."""
         s = self.spec
         with self._rng_lock:
             r = self._rng
@@ -160,6 +226,7 @@ class FaultyCommManager(BaseCommunicationManager):
                 "dup": s.dup > 0 and r.random_sample() < s.dup,
                 "delay": (s.delay > 0 and s.delay_prob > 0
                           and r.random_sample() < s.delay_prob),
+                "fail": s.fail > 0 and r.random_sample() < s.fail,
             }
         for kind, hit in plan.items():
             if hit:
@@ -167,6 +234,26 @@ class FaultyCommManager(BaseCommunicationManager):
                 trace.event("comm/fault", kind=kind, msg_type=msg_type,
                             sender=self.rank, receiver=receiver)
         return plan
+
+    def _maybe_crash(self, round_idx) -> None:
+        """``crash=r``: die on the first send touching round >= r, and stay
+        dead — once crashed, EVERY later send from this rank raises too
+        (heartbeat threads and other round-index-free senders included: a
+        dead process sends nothing). Checked before anything else on the
+        send path (a dead process does not get to pick which messages
+        still leave)."""
+        if self._crashed:
+            raise InjectedCrash(f"rank {self.rank} is crashed (injected)")
+        cr = self.spec.crash_round
+        if cr >= 0 and round_idx is not None and int(round_idx) >= cr:
+            self._crashed = True
+            trace.event("comm/fault", kind="crash", sender=self.rank,
+                        round=int(round_idx))
+            self.applied.append(("crash", -1, -1))
+            raise InjectedCrash(
+                f"rank {self.rank} crashed at round {int(round_idx)} "
+                f"(injected crash={cr})"
+            )
 
     def _corrupt_message(self, msg: Message) -> Message:
         """Copy ``msg`` with seeded byte flips in its model payload(s)."""
@@ -199,10 +286,16 @@ class FaultyCommManager(BaseCommunicationManager):
         return bool(msg.get("finished"))
 
     def send_message(self, msg: Message) -> None:
+        self._maybe_crash(msg.get(_ROUND_IDX_KEY))
         if not self.spec.active or self._protected(msg):
             self.inner.send_message(msg)
             return
         plan = self._decide(msg.get_type(), msg.get_receiver_id())
+        if plan["fail"]:
+            raise TransientSendError(
+                f"injected send failure rank {self.rank} -> "
+                f"{msg.get_receiver_id()}"
+            )
         if plan["drop"]:
             return
         if plan["corrupt"]:
@@ -213,6 +306,9 @@ class FaultyCommManager(BaseCommunicationManager):
 
     def broadcast_message(self, msg: Message, receiver_ids: list,
                           per_receiver: dict | None = None) -> None:
+        # crash is checked at fan-out entry, NOT per leg: process death
+        # must escape the broadcast's per-destination fault isolation
+        self._maybe_crash(msg.get(_ROUND_IDX_KEY))
         if not self.spec.active or self._protected(msg):
             self.inner.broadcast_message(msg, receiver_ids, per_receiver)
             return
@@ -223,6 +319,10 @@ class FaultyCommManager(BaseCommunicationManager):
     def _send_framed(self, frame: FramedMessage, dst: int,
                      overrides: dict | None = None) -> None:
         plan = self._decide(frame._header.get(Message.MSG_ARG_KEY_TYPE, 0), dst)
+        if plan["fail"]:
+            raise TransientSendError(
+                f"injected send failure rank {self.rank} -> {dst}"
+            )
         if plan["drop"]:
             return
         if plan["corrupt"]:
@@ -234,6 +334,42 @@ class FaultyCommManager(BaseCommunicationManager):
             thunk = [lambda: self.inner._send_framed(frame, dst, overrides)]
         self._deliver(thunk * (2 if plan["dup"] else 1),
                       self.spec.delay if plan["delay"] else 0.0)
+
+
+class _RecvFaultShim:
+    """Observer wrapper applying receive-side faults before delivery.
+
+    Wraps each observer registered through a :class:`FaultyCommManager`
+    whose spec has receive faults: arriving messages are dropped or
+    delivered late on a timer thread (seeded, independent rng stream from
+    the send side). ``finished`` stop messages pass through untouched —
+    same liveness rationale as the send side."""
+
+    def __init__(self, mgr: "FaultyCommManager", observer):
+        self._mgr = mgr
+        self._observer = observer
+
+    def receive_message(self, msg_type: int, msg: Message) -> None:
+        mgr, s = self._mgr, self._mgr.spec
+        if FaultyCommManager._protected(msg):
+            self._observer.receive_message(msg_type, msg)
+            return
+        with mgr._rng_lock:
+            r = mgr._recv_rng
+            drop = s.recv_drop > 0 and r.random_sample() < s.recv_drop
+            delay = (s.recv_delay > 0 and s.recv_delay_prob > 0
+                     and r.random_sample() < s.recv_delay_prob)
+        for kind, hit in (("recv_drop", drop), ("recv_delay", delay)):
+            if hit:
+                mgr.applied.append((kind, msg_type, mgr.rank))
+                trace.event("comm/fault", kind=kind, msg_type=msg_type,
+                            sender=msg.get_sender_id(), receiver=mgr.rank)
+        if drop:
+            return
+        mgr._deliver(
+            [lambda: self._observer.receive_message(msg_type, msg)],
+            s.recv_delay if delay else 0.0,
+        )
 
 
 def wrap_make_comm(make_comm, specs, seed: int = 0, registry: list | None = None):

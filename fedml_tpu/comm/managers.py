@@ -87,15 +87,26 @@ class DistributedManager(Observer):
             handler(msg)
 
     def send_message(self, msg: Message) -> None:
+        # retry/backoff send plane: when the transport carries a policy,
+        # unary sends re-attempt on transient failure (comm/retry.py) —
+        # each attempt re-runs the full send path (fault wrappers included)
+        policy = getattr(self.comm, "retry_policy", None)
+        if policy is None:
+            send = lambda: self.comm.send_message(msg)  # noqa: E731
+        else:
+            send = lambda: policy.run(  # noqa: E731
+                lambda: self.comm.send_message(msg),
+                dst=msg.get_receiver_id(), msg_type=msg.get_type(),
+            )
         tracer = trace.get()
         if tracer is None:  # disabled path: skip the payload-size walk too
-            self.comm.send_message(msg)
+            send()
             return
         with tracer.span("comm/send", msg_type=msg.get_type(),
                          sender=self.rank,
                          receiver=msg.get_receiver_id(),
                          bytes=msg.payload_nbytes()):
-            self.comm.send_message(msg)
+            send()
 
     def broadcast_message(self, msg: Message, receiver_ids: list[int],
                           per_receiver: dict[int, dict] | None = None) -> None:

@@ -139,6 +139,17 @@ class OffloadCommManager(BaseCommunicationManager):
 
     # -- send path ----------------------------------------------------------
 
+    def _put(self, key: str, data: bytes) -> None:
+        """Data-plane upload, under the retry plane when one is armed: a
+        transient object-store hiccup is exactly the failure comm/retry.py
+        exists for, and the put happens before any per-destination send
+        isolation could cover it."""
+        policy = self.retry_policy
+        if policy is None:
+            self.store.put(key, data)
+        else:
+            policy.run(lambda: self.store.put(key, data), store_key=key)
+
     def _offload_params(self, msg: Message) -> tuple[Message, dict[str, str], dict[str, str]]:
         """Upload every over-threshold array/text param once and strip it
         from a shallow copy of ``msg`` (the caller's Message stays intact so
@@ -152,12 +163,12 @@ class OffloadCommManager(BaseCommunicationManager):
         for k, v in list(out.msg_params.items()):
             if isinstance(v, np.ndarray) and v.nbytes >= self.threshold:
                 key = f"{k}-{uuid.uuid4().hex}"
-                self.store.put(key, _array_bytes(v))
+                self._put(key, _array_bytes(v))
                 offloaded[k] = key
                 del out.msg_params[k]
             elif isinstance(v, str) and len(v) >= self.threshold:
                 key = f"{k}-{uuid.uuid4().hex}"
-                self.store.put(key, v.encode("utf-8"))
+                self._put(key, v.encode("utf-8"))
                 offloaded_text[k] = key
                 del out.msg_params[k]
         if offloaded:
@@ -196,6 +207,10 @@ class OffloadCommManager(BaseCommunicationManager):
                         self.store.delete(key)
                     except OSError:
                         pass
+        # the retry plane (comm/retry.py) arms the OUTERMOST manager; the
+        # fan-out legs run inside the inner transport, so delegate the
+        # policy there for the duration of this composition
+        self.inner.retry_policy = self.retry_policy
         self.inner.broadcast_message(out, receiver_ids, per_receiver)
 
     # -- receive path -------------------------------------------------------

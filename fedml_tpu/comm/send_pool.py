@@ -11,8 +11,11 @@ Ordering contract: each destination is hashed to ONE worker thread, so two
 sends to the same receiver can never reorder (the per-backend FIFO the
 protocol layers rely on survives pooling); sends to different receivers run
 concurrently. :meth:`SendWorkerPool.run_all` is a barrier — it returns after
-every submitted send completed and re-raises the first send error — so a
-broadcast call keeps its synchronous semantics while its legs overlap.
+every submitted send completed — so a broadcast call keeps its synchronous
+semantics while its legs overlap. Failures are per-destination isolated:
+every leg runs to completion regardless of the others, and ALL errors are
+collected into one :class:`BroadcastSendError` naming the destination ranks
+(a multi-receiver outage used to be reported as a single anonymous failure).
 """
 
 from __future__ import annotations
@@ -20,6 +23,25 @@ from __future__ import annotations
 import queue
 import threading
 from typing import Callable
+
+
+class BroadcastSendError(RuntimeError):
+    """One or more per-destination sends of a fan-out failed. ``errors``
+    maps destination rank -> the exception its send raised; the message
+    names every failed rank so a multi-receiver outage is diagnosable from
+    the log alone. Raised by :meth:`SendWorkerPool.run_all` and by the
+    serial broadcast path in ``comm.base``."""
+
+    def __init__(self, errors: dict[int, BaseException]):
+        self.errors = dict(errors)
+        detail = "; ".join(
+            f"dst {d}: {type(e).__name__}: {e}"
+            for d, e in sorted(self.errors.items())
+        )
+        super().__init__(
+            f"broadcast failed to {len(self.errors)} receiver(s) "
+            f"{sorted(self.errors)} — {detail}"
+        )
 
 
 class SendWorkerPool:
@@ -63,22 +85,25 @@ class SendWorkerPool:
                 timeout: float | None = None) -> None:
         """Run ``(destination, send_fn)`` tasks on the pool and block until
         all complete. Same-destination tasks run in submission order on one
-        worker; distinct destinations overlap. Raises the first send error
-        (remaining sends still run to completion first)."""
+        worker; distinct destinations overlap. Every task runs to
+        completion regardless of other tasks' failures; if any failed, a
+        :class:`BroadcastSendError` naming ALL failed destinations is
+        raised."""
         if not tasks:
             return
         self._ensure_started()
-        errors: list[BaseException] = []
+        errors: dict[int, BaseException] = {}
         done = threading.Event()
         state_lock = threading.Lock()
         remaining = [len(tasks)]
 
-        def wrap(fn: Callable[[], None]) -> Callable[[], None]:
+        def wrap(dst: int, fn: Callable[[], None]) -> Callable[[], None]:
             def run() -> None:
                 try:
                     fn()
                 except BaseException as e:  # noqa: BLE001 — re-raised below
-                    errors.append(e)
+                    with state_lock:
+                        errors[dst] = e
                 finally:
                     with state_lock:
                         remaining[0] -= 1
@@ -87,14 +112,14 @@ class SendWorkerPool:
             return run
 
         for dst, fn in tasks:
-            self._queues[hash(dst) % self.workers].put(wrap(fn))
+            self._queues[hash(dst) % self.workers].put(wrap(dst, fn))
         if not done.wait(timeout):
             raise TimeoutError(
                 f"{remaining[0]} of {len(tasks)} pooled sends still pending "
                 f"after {timeout}s"
             )
         if errors:
-            raise errors[0]
+            raise BroadcastSendError(errors)
 
     def close(self, timeout: float = 5.0) -> None:
         """Stop the workers (idempotent). Queued work submitted before close

@@ -12,6 +12,15 @@ The server holds a ClientStatusTracker and starts the round protocol once
 every expected client reported ONLINE — replacing the reference's implicit
 "MPI processes all exist" assumption with an explicit, failure-aware
 handshake.
+
+On top of the handshake the module carries the liveness half of the
+fault-tolerant runtime (docs/ROBUSTNESS.md "Failure recovery"):
+:class:`HeartbeatSender` re-sends ONLINE status on an interval from a
+daemon thread, so the tracker's ``last_seen`` stays fresh while a worker
+computes — letting the server distinguish SLOW (alive, missed the round
+deadline, heartbeat fresh) from dead (silent on both planes) before the
+elastic timeout fires, and letting an OFFLINE-excluded worker announce its
+return for readmission.
 """
 
 from __future__ import annotations
@@ -29,6 +38,10 @@ class ClientStatus:
     ONLINE = "ONLINE"
     FINISHED = "FINISHED"
     OFFLINE = "OFFLINE"
+    # alive (heartbeat fresh) but missed the round deadline — dropped from
+    # the round's aggregate like a dead worker, but diagnosably different
+    # in the status table and eligible for contact-driven readmission
+    SLOW = "SLOW"
 
     KEY_STATUS = "client_status"
     KEY_OS = "client_os"  # reference tags client OS in status msgs (message.py:21-24)
@@ -54,10 +67,15 @@ class ClientStatusTracker:
         self._lock = threading.Lock()
         self._all_online = threading.Event()
 
-    def update(self, client_id: int, status: str) -> None:
+    def update(self, client_id: int, status: str, touch: bool = True) -> None:
+        """Record ``status`` for the client. ``touch=False`` marks a
+        SERVER-side judgement (SLOW/OFFLINE labels) without refreshing
+        ``last_seen`` — only actual contact from the client may count as
+        liveness evidence."""
         with self._lock:
             self._status[client_id] = status
-            self._last_seen[client_id] = time.monotonic()
+            if touch:
+                self._last_seen[client_id] = time.monotonic()
             online = sum(1 for s in self._status.values() if s == ClientStatus.ONLINE)
             if online >= self.expected:
                 self._all_online.set()
@@ -75,6 +93,19 @@ class ClientStatusTracker:
             )
 
 
+    def last_seen(self, client_id: int) -> float | None:
+        """``time.monotonic`` of the client's last status contact (None if
+        it never reported)."""
+        with self._lock:
+            return self._last_seen.get(client_id)
+
+    def seen_within(self, client_id: int, window: float) -> bool:
+        """True when the client reported status within the last ``window``
+        seconds — the slow-vs-dead discriminator: a worker that missed the
+        round deadline but heartbeats is SLOW, not dead."""
+        seen = self.last_seen(client_id)
+        return seen is not None and time.monotonic() - seen <= window
+
     def handle_message(self, msg: Message) -> None:
         self.update(msg.get_sender_id(), msg.get(ClientStatus.KEY_STATUS))
 
@@ -88,3 +119,51 @@ class ClientStatusTracker:
     def finished_count(self) -> int:
         with self._lock:
             return sum(1 for s in self._status.values() if s == ClientStatus.FINISHED)
+
+
+class HeartbeatSender:
+    """Periodic ONLINE status from a daemon thread (docs/ROBUSTNESS.md
+    "Failure recovery").
+
+    Heartbeats are ordinary :func:`send_client_status` messages, so they
+    ride any backend (and any fault wrapper) unchanged; the server's
+    status handler feeds them into its :class:`ClientStatusTracker`. Send
+    errors are swallowed — a heartbeat is best-effort by definition, and a
+    sender must survive its transport flapping (or the server restarting
+    mid-run). Heartbeats never touch aggregation state, so a heartbeating
+    run is bit-identical to a silent one (tools/ft_smoke.py guards this).
+    """
+
+    def __init__(self, comm: BaseCommunicationManager, client_id: int,
+                 interval: float, receiver_id: int = 0):
+        if interval <= 0:
+            raise ValueError(f"heartbeat interval must be > 0, got {interval}")
+        self.comm = comm
+        self.client_id = client_id
+        self.interval = float(interval)
+        self.receiver_id = receiver_id
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                send_client_status(self.comm, self.client_id,
+                                   ClientStatus.ONLINE, self.receiver_id)
+            except Exception:  # noqa: BLE001 — best-effort by contract
+                pass
+            self._stop.wait(self.interval)
+
+    def start(self) -> "HeartbeatSender":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name=f"heartbeat-c{self.client_id}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 2.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)

@@ -118,8 +118,26 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="seeded wire-fault injection on the "
                              "message-passing backends (comm/faults.py): "
                              "';'-separated '<rank|*>:<fault>=<val>,...' "
-                             "with faults drop|delay[@p]|dup|corrupt, e.g. "
-                             "'2:drop=1.0;*:corrupt=0.05'")
+                             "with faults drop|delay[@p]|dup|corrupt|fail|"
+                             "recv_drop|recv_delay[@p]|crash, e.g. "
+                             "'2:drop=1.0;*:corrupt=0.05' or '0:crash=3'")
+    # fault-tolerant runtime (docs/ROBUSTNESS.md "Failure recovery");
+    # message-passing backends only
+    parser.add_argument("--send_retries", type=int, default=0,
+                        help="re-attempts per failed send on the "
+                             "message-passing backends (comm/retry.py "
+                             "exponential backoff + jitter); 0 = a "
+                             "transient send failure fails that leg. "
+                             "Fault-free runs are bit-identical either way")
+    parser.add_argument("--retry_base_delay", type=float, default=0.05,
+                        help="first-retry backoff in seconds (doubles per "
+                             "attempt, jittered)")
+    parser.add_argument("--heartbeat_interval", type=float, default=0.0,
+                        help="seconds between client heartbeat status "
+                             "messages (comm/status.py HeartbeatSender); "
+                             "lets the server tell SLOW from dead before "
+                             "the round timeout and enables readmission of "
+                             "excluded workers that reappear. 0 = off")
     # update compression (fedml_tpu/compress, docs/COMPRESSION.md)
     parser.add_argument("--compressor", type=str, default="none",
                         help="client->server update codec: none | bf16 | "
@@ -377,6 +395,29 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     if getattr(args, "fault_spec", None):
         robust_kwargs["fault_specs"] = args.fault_spec
         robust_kwargs["fault_seed"] = cfg.seed
+    ft_kwargs: dict = {}
+    if getattr(args, "send_retries", 0):
+        from fedml_tpu.comm.retry import RetryPolicy
+
+        ft_kwargs["retry_policy"] = RetryPolicy(
+            max_attempts=1 + args.send_retries,
+            base_delay=getattr(args, "retry_base_delay", 0.05),
+        )
+        if getattr(args, "compressor", "none") == "none":
+            # Comm/RetryCount rides comm_stats totals; with a codec the
+            # compressed path passes the same dict itself
+            ft_kwargs["comm_stats"] = comm_stats
+    if getattr(args, "heartbeat_interval", 0.0):
+        ft_kwargs["heartbeat_interval"] = args.heartbeat_interval
+    if getattr(args, "checkpoint_dir", None):
+        # crash-recoverable server round state: snapshot every
+        # --checkpoint_every round closes; --resume restores the latest
+        # snapshot and re-broadcasts its round (docs/ROBUSTNESS.md)
+        ft_kwargs["checkpoint_dir"] = args.checkpoint_dir
+        ft_kwargs["checkpoint_every"] = max(
+            1, getattr(args, "checkpoint_every", 0) or 1
+        )
+        ft_kwargs["resume"] = bool(getattr(args, "resume", 0))
     if getattr(args, "compressor", "none") != "none":
         if getattr(args, "is_mobile", 0):
             raise NotImplementedError(
@@ -418,6 +459,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         **mobile_kwargs,
         **codec_kwargs,
         **robust_kwargs,
+        **ft_kwargs,
     )
     if comm_stats.get("totals"):
         logging.info("bytes on wire: %s", comm_stats["totals"])
@@ -455,6 +497,13 @@ def _run(args) -> list[dict]:
     if getattr(args, "fault_spec", None) and args.backend == "sim":
         raise NotImplementedError(
             "--fault_spec injects wire faults — there is no wire on "
+            "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
+        )
+    if (getattr(args, "send_retries", 0)
+            or getattr(args, "heartbeat_interval", 0.0)) and args.backend == "sim":
+        raise NotImplementedError(
+            "--send_retries/--heartbeat_interval configure the "
+            "message-passing send/liveness planes — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
         )
     if (getattr(args, "shard_rules", None)

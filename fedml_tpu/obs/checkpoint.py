@@ -174,3 +174,83 @@ class RoundCheckpointer:
 
             shutil.rmtree(p, ignore_errors=True)
             logging.debug("checkpoint gc: removed %s", p)
+
+    # -- distributed-server snapshots (docs/ROBUSTNESS.md "Failure recovery")
+
+    # The message-passing server's round state is a nested dict mixing
+    # numpy arrays (global flat model, streaming-accumulator tally,
+    # reservoir stacks) with JSON-safe scalars/tables (round index, weight
+    # sum, miss counts, status table). Arrays land in one .npz keyed by
+    # '/'-joined paths; everything else lands in a .json written LAST — its
+    # presence is the commit marker, so a crash DURING a save can never
+    # yield a half-readable snapshot (restore only ever sees committed
+    # rounds).
+
+    def _server_paths(self, round_idx: int) -> tuple[Path, Path]:
+        stem = self.dir / f"server_round_{round_idx:06d}"
+        return stem.with_suffix(".npz"), stem.with_suffix(".json")
+
+    def save_server(self, round_idx: int, state: dict) -> Path:
+        """Save a distributed-server round snapshot (atomic at the .json
+        commit marker). ``state`` is a nested dict of np.ndarray leaves and
+        JSON-safe values."""
+        arrays: dict[str, np.ndarray] = {}
+
+        def strip(node, prefix: str):
+            if isinstance(node, dict):
+                return {k: strip(v, f"{prefix}/{k}" if prefix else str(k))
+                        for k, v in node.items()}
+            if isinstance(node, np.ndarray):
+                arrays[prefix] = node
+                return {"__array__": prefix}
+            return node
+
+        meta = strip(state, "")
+        npz_path, json_path = self._server_paths(round_idx)
+        if arrays:
+            np.savez(npz_path, **arrays)
+        # the .json is the commit marker, so its own write must be atomic:
+        # dump to a temp file and rename into place — a crash mid-dump
+        # leaves no half-readable marker for restore to trip on
+        tmp = json_path.with_suffix(".json.tmp")
+        with open(tmp, "w") as fh:
+            json.dump({"round": round_idx, "state": meta,
+                       "has_arrays": bool(arrays)}, fh)
+        tmp.replace(json_path)
+        self._gc_server()
+        return json_path
+
+    def latest_server_round(self) -> int | None:
+        rounds = sorted(
+            int(p.stem.split("_")[-1])
+            for p in self.dir.glob("server_round_*.json")
+        )
+        return rounds[-1] if rounds else None
+
+    def restore_server(self, round_idx: int | None = None) -> dict:
+        """Load a server snapshot (latest committed round by default) back
+        into the nested dict :meth:`save_server` was given."""
+        if round_idx is None:
+            round_idx = self.latest_server_round()
+        if round_idx is None:
+            raise FileNotFoundError(f"no server checkpoints under {self.dir}")
+        npz_path, json_path = self._server_paths(round_idx)
+        with open(json_path) as fh:
+            payload = json.load(fh)
+        blob = np.load(npz_path) if payload.get("has_arrays") else None
+
+        def graft(node):
+            if isinstance(node, dict):
+                if set(node) == {"__array__"}:
+                    return blob[node["__array__"]]
+                return {k: graft(v) for k, v in node.items()}
+            return node
+
+        return graft(payload["state"])
+
+    def _gc_server(self):
+        rounds = sorted(self.dir.glob("server_round_*.json"))
+        for json_path in rounds[: -self.keep]:
+            json_path.with_suffix(".npz").unlink(missing_ok=True)
+            json_path.unlink(missing_ok=True)
+            logging.debug("checkpoint gc: removed %s", json_path.stem)

@@ -35,6 +35,12 @@ COMM_DOWNLINK_RATIO = "Comm/DownlinkCompressionRatio"
 # ratio keys are derived, not additive — totals() must never sum them
 _RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
 
+# retry/backoff send plane (comm/retry.py, docs/ROBUSTNESS.md "Failure
+# recovery"): how many send attempts were re-tried after a transient
+# failure over the whole run. Emitted into comm_stats totals by
+# run_distributed_fedavg when a RetryPolicy is armed.
+COMM_RETRY_COUNT = "Comm/RetryCount"
+
 # Robust-aggregation defense keys (docs/ROBUSTNESS.md): per-round mean
 # pre-clip update norm, fraction of the cohort whose delta got clipped, and
 # how many client updates the combine rule discarded (krum keeps one,

@@ -1,0 +1,742 @@
+"""Fault-tolerant distributed runtime (docs/ROBUSTNESS.md "Failure
+recovery"): retry/backoff send plane, heartbeat liveness + readmission,
+crash-recoverable server round state, and the new fault kinds.
+
+Covers the attack/fault matrix: transient send failures recovered by
+retry (Comm/RetryCount > 0, rounds complete), a dead worker excluded then
+READMITTED after reappearing, an all-dropped round surfacing
+EmptyRoundError with named ranks, plus the ClientStatusTracker state
+transitions and the ``exclude_after`` boundary.
+"""
+
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms import fedavg_distributed as fd
+from fedml_tpu.algorithms.fedavg_distributed import (
+    EmptyRoundError,
+    FedAvgDistAggregator,
+    FedAvgServerManager,
+    MyMessage,
+    init_template,
+    run_distributed_fedavg,
+)
+from fedml_tpu.comm.faults import (
+    FaultSpec,
+    FaultyCommManager,
+    InjectedCrash,
+    TransientSendError,
+    parse_fault_spec,
+)
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.retry import (
+    RetryPolicy,
+    SendAttemptTimeout,
+    reset_retry_stats,
+    retry_stats,
+)
+from fedml_tpu.comm.send_pool import BroadcastSendError, SendWorkerPool
+from fedml_tpu.comm.status import ClientStatus, ClientStatusTracker, HeartbeatSender
+from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import metrics as metricslib
+
+
+def _blob_setup(workers=3, classes=4):
+    train, _ = gaussian_blobs(
+        n_clients=workers, samples_per_client=24, num_classes=classes, seed=3
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=classes),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+    return trainer, train
+
+
+def _warm_jit(trainer, train, batch_size=8):
+    """Pre-compile the client train program so elastic-timeout tests do not
+    race cold XLA compilation (same rationale as test_elastic_and_stubs)."""
+    import jax.numpy as jnp
+
+    from fedml_tpu.sim.cohort import stack_cohort
+
+    batches, _ = stack_cohort(train, np.asarray([0]), batch_size)
+    batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
+    sample = jax.tree.map(lambda v: v[0], batches)
+    variables = trainer.init(jax.random.key(0), sample)
+    fn = jax.jit(make_local_train(trainer))
+    out, _ = fn(variables, batches, jax.random.key(1))
+    jax.block_until_ready(jax.tree.leaves(out)[0])
+
+
+# ---------------------------------------------------------------------------
+# retry policy unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_recovers_then_gives_up():
+    reset_retry_stats()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    policy = RetryPolicy(max_attempts=4, base_delay=0.001, jitter=0.0)
+    assert policy.run(flaky) == "ok"
+    assert len(calls) == 3
+    assert retry_stats()["retries"] == 2
+
+    def always():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError, match="down"):
+        policy.run(always)
+    assert retry_stats()["gave_up"] == 1
+    # 3 more re-attempts happened before giving up (4 attempts total)
+    assert retry_stats()["retries"] == 5
+
+
+def test_retry_policy_unretryable_propagates_immediately():
+    calls = []
+
+    def crash():
+        calls.append(1)
+        raise InjectedCrash("dead")
+
+    with pytest.raises(InjectedCrash):
+        RetryPolicy(max_attempts=5, base_delay=0.001).run(crash)
+    assert len(calls) == 1  # a crash is not re-attempted
+
+
+def test_retry_policy_attempt_timeout():
+    policy = RetryPolicy(max_attempts=2, base_delay=0.001,
+                         attempt_timeout=0.05)
+
+    def hangs():
+        time.sleep(5.0)
+
+    t0 = time.perf_counter()
+    with pytest.raises(SendAttemptTimeout):
+        policy.run(hangs)
+    assert time.perf_counter() - t0 < 2.0  # both attempts bounded, not 10 s
+
+
+def test_retry_policy_validates():
+    with pytest.raises(ValueError, match="max_attempts"):
+        RetryPolicy(max_attempts=0)
+    with pytest.raises(ValueError, match="backoff"):
+        RetryPolicy(backoff=0.5)
+    d = RetryPolicy(base_delay=0.1, backoff=2.0, max_delay=0.15, jitter=0.0)
+    assert d.delay_for(1) == pytest.approx(0.1)
+    assert d.delay_for(2) == pytest.approx(0.15)  # capped
+
+
+# ---------------------------------------------------------------------------
+# fault-isolated fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_send_pool_collects_all_errors_with_ranks():
+    pool = SendWorkerPool(workers=3, name="t-ft-err")
+    ran = []
+
+    def boom(dst):
+        def run():
+            raise ConnectionError(f"dst{dst} down")
+        return run
+
+    try:
+        with pytest.raises(BroadcastSendError) as ei:
+            pool.run_all([(0, boom(0)), (1, lambda: ran.append(1)),
+                          (2, boom(2))])
+        assert sorted(ei.value.errors) == [0, 2]
+        assert "dst 0" in str(ei.value) and "dst 2" in str(ei.value)
+        assert ran == [1]  # the healthy leg still completed
+    finally:
+        pool.close()
+
+
+class _DropToRankComm(LoopbackCommManager):
+    """Server transport whose sends to one rank always fail."""
+
+    def __init__(self, fabric, rank, bad_dst):
+        super().__init__(fabric, rank)
+        self.bad_dst = bad_dst
+
+    def _send_framed(self, frame, dst, overrides=None):
+        if dst == self.bad_dst:
+            raise ConnectionError(f"receiver {dst} unreachable")
+        super()._send_framed(frame, dst, overrides)
+
+    def send_message(self, msg):
+        if msg.get_receiver_id() == self.bad_dst:
+            raise ConnectionError(f"receiver {self.bad_dst} unreachable")
+        super().send_message(msg)
+
+
+@pytest.mark.parametrize("use_broadcast", [True, False])
+def test_fanout_one_dead_receiver_does_not_abort_broadcast(use_broadcast):
+    """A permanently-failing downlink leg is logged and skipped — the other
+    ranks still receive their sync (satellite: per-destination isolation)."""
+    trainer, train = _blob_setup(workers=3)
+    _, flat, desc = init_template(trainer, train.arrays, 8)
+    fabric = LoopbackFabric(4)
+    server = FedAvgServerManager(
+        _DropToRankComm(fabric, 0, bad_dst=2), 3, 1, flat, desc,
+        use_broadcast=use_broadcast,
+    )
+    server.send_init_msg()  # must not raise
+    assert fabric.queues[1].qsize() == 1
+    assert fabric.queues[2].qsize() == 0
+    assert fabric.queues[3].qsize() == 1
+
+
+def test_fanout_reraises_injected_crash():
+    """A crash fault escaping through the fan-out is NOT absorbed by the
+    per-destination isolation — it kills the protocol loop, as designed."""
+    trainer, train = _blob_setup(workers=2)
+    _, flat, desc = init_template(trainer, train.arrays, 8)
+    fabric = LoopbackFabric(3)
+    comm = FaultyCommManager(LoopbackCommManager(fabric, 0),
+                             FaultSpec(crash_round=0), rank=0)
+    server = FedAvgServerManager(comm, 2, 1, flat, desc)
+    with pytest.raises(InjectedCrash):
+        server.send_init_msg()
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: transient send failures recovered by retry
+# ---------------------------------------------------------------------------
+
+
+def test_transient_send_failures_recovered_by_retry():
+    trainer, train = _blob_setup(workers=2)
+    comm_stats: dict = {}
+    fabric = LoopbackFabric(3)
+    final = run_distributed_fedavg(
+        trainer, train, worker_num=2, round_num=3, batch_size=8,
+        make_comm=lambda r: LoopbackCommManager(fabric, r),
+        fault_specs={1: FaultSpec(fail=0.5)}, fault_seed=7,
+        retry_policy=RetryPolicy(max_attempts=8, base_delay=0.005,
+                                 jitter=0.0),
+        comm_stats=comm_stats,
+    )
+    for leaf in jax.tree.leaves(final):
+        assert np.isfinite(np.asarray(leaf)).all()
+    # the injected failures actually fired AND were recovered
+    assert comm_stats["totals"][metricslib.COMM_RETRY_COUNT] > 0
+
+
+def test_send_failure_without_retry_is_fatal_for_that_leg():
+    """Control arm: the same fail fault with no retry policy loses the
+    upload (TransientSendError surfaces on the client thread) — retry is
+    what turns it into a recovered round."""
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(fail=1.0), rank=1, seed=0)
+    msg = Message(3, 1, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, np.zeros(4, np.uint8))
+    with pytest.raises(TransientSendError):
+        mgr.send_message(msg)
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: dead worker excluded, then readmitted on reappearance
+# ---------------------------------------------------------------------------
+
+
+class _BlackoutComm(LoopbackCommManager):
+    """Client transport that silently swallows every send while
+    ``blackout`` is set — the worker looks dead on both planes."""
+
+    def __init__(self, fabric, rank, blackout: threading.Event):
+        super().__init__(fabric, rank)
+        self.blackout = blackout
+
+    def send_message(self, msg):
+        if self.blackout.is_set():
+            return
+        super().send_message(msg)
+
+
+def test_dead_worker_excluded_then_readmitted():
+    trainer, train = _blob_setup(workers=3)
+    _warm_jit(trainer, train)
+    fabric = LoopbackFabric(4)
+    blackout = threading.Event()
+    blackout.set()  # worker rank 3 starts dead
+    server_holder: dict = {}
+    accepted: list[tuple[int, int]] = []  # (round, sender) tallied uploads
+
+    orig = fd.FedAvgServerManager
+
+    class CapturingServer(orig):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            server_holder["server"] = self
+
+        def _on_model_from_client(self, msg):
+            r = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+            with self._round_lock:
+                live = self.aggregator.is_live(msg.get_sender_id() - 1)
+                current = (r is not None and int(r) == self.round_idx)
+            if live and current:
+                accepted.append((int(r), msg.get_sender_id()))
+            super()._on_model_from_client(msg)
+
+    def make_comm(rank):
+        if rank == 3:
+            return _BlackoutComm(fabric, rank, blackout)
+        return LoopbackCommManager(fabric, rank)
+
+    def watcher():
+        # end the blackout as soon as the server excludes the worker — its
+        # heartbeats then resume and should drive readmission
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            server = server_holder.get("server")
+            if server is not None and not server.aggregator.is_live(2):
+                blackout.clear()
+                return
+            time.sleep(0.02)
+
+    w = threading.Thread(target=watcher, daemon=True)
+    w.start()
+    fd.FedAvgServerManager = CapturingServer
+    try:
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=3, round_num=6, batch_size=8,
+            make_comm=make_comm, round_timeout=1.0,
+            server_kwargs={"exclude_after": 1},
+            heartbeat_interval=0.05,  # implies readmission=True
+            # pace the healthy ranks' uploads (~0.15 s/round) so the
+            # returnee's heartbeats can land between round closes — without
+            # it the 2-worker rounds finish in microseconds and the run
+            # ends before readmission can take effect
+            fault_specs={1: FaultSpec(delay=0.15), 2: FaultSpec(delay=0.15)},
+        )
+    finally:
+        fd.FedAvgServerManager = orig
+    w.join(timeout=5)
+    for leaf in jax.tree.leaves(final):
+        assert np.isfinite(np.asarray(leaf)).all()
+    server = server_holder["server"]
+    assert server.round_idx == 6
+    # the worker was readmitted: back in the live set, marked ONLINE again
+    assert server.aggregator.live_workers() == [0, 1, 2]
+    assert server.aggregator.excluded_workers() == []
+    assert server.status.snapshot().get(3) == ClientStatus.ONLINE
+    # ... and it actually CONTRIBUTED to at least one later round's tally
+    assert any(sender == 3 for _, sender in accepted), accepted
+
+
+# ---------------------------------------------------------------------------
+# fault matrix: all-dropped round surfaces EmptyRoundError with named ranks
+# ---------------------------------------------------------------------------
+
+
+def test_empty_round_error_names_missing_and_offline_ranks():
+    agg = FedAvgDistAggregator(3)
+    agg.exclude_worker(2)  # rank 3 already OFFLINE
+    with pytest.raises(EmptyRoundError) as ei:
+        agg.aggregate()
+    text = str(ei.value)
+    assert "no worker uploads" in text
+    assert "[1, 2]" in text  # the missing live ranks, by name
+    assert "[3]" in text and "OFFLINE" in text  # the excluded rank, by name
+
+
+def test_all_uplinks_dropped_names_ranks_end_to_end():
+    trainer, train = _blob_setup(workers=2)
+    _, flat, desc = init_template(trainer, train.arrays, 8)
+    from fedml_tpu.comm.faults import wrap_make_comm
+
+    fabric = LoopbackFabric(3)
+    make_comm = wrap_make_comm(
+        lambda r: LoopbackCommManager(fabric, r),
+        {1: FaultSpec(drop=1.0), 2: FaultSpec(drop=1.0)},
+    )
+    server = FedAvgServerManager(make_comm(0), 2, 2, flat, desc,
+                                 round_timeout=0.2)
+    clients = [
+        fd.FedAvgClientManager(make_comm(r), r, 3, trainer, train, 8, None)
+        for r in (1, 2)
+    ]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.register_message_receive_handlers()
+    server.send_init_msg()
+    st = threading.Thread(target=server.comm.handle_receive_message,
+                          daemon=True)
+    st.start()
+    try:
+        time.sleep(1.0)
+        assert server.round_idx == 0
+        with pytest.raises(EmptyRoundError, match=r"ranks \[1, 2\]"):
+            server.aggregator.aggregate()
+    finally:
+        for c in clients:
+            c.finish()
+        server.finish()
+        st.join(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# status tracker transitions + heartbeats
+# ---------------------------------------------------------------------------
+
+
+def test_status_tracker_transitions_online_slow_offline_readmitted():
+    t = ClientStatusTracker(expected_clients=2)
+    t.update(1, ClientStatus.ONLINE)
+    assert t.seen_within(1, 10.0)
+    # server judgement marks (SLOW/OFFLINE) must NOT count as contact
+    t.update(1, ClientStatus.SLOW, touch=False)
+    assert t.snapshot()[1] == ClientStatus.SLOW
+    time.sleep(0.12)
+    assert not t.seen_within(1, 0.1)
+    t.update(1, ClientStatus.OFFLINE, touch=False)
+    assert t.snapshot()[1] == ClientStatus.OFFLINE
+    assert t.stale(0.0) == []  # OFFLINE is terminal for stale()
+    # contact readmits: status and liveness refresh together
+    t.update(1, ClientStatus.ONLINE)
+    assert t.snapshot()[1] == ClientStatus.ONLINE
+    assert t.seen_within(1, 10.0)
+    assert t.last_seen(2) is None  # never-seen client
+
+
+def test_heartbeat_sender_emits_periodic_status():
+    fabric = LoopbackFabric(2)
+    hb = HeartbeatSender(LoopbackCommManager(fabric, 1), client_id=1,
+                         interval=0.03)
+    hb.start()
+    time.sleep(0.2)
+    hb.stop()
+    n = fabric.queues[0].qsize()
+    assert n >= 3, n
+    msg = Message.from_bytes(fabric.queues[0].get())
+    assert msg.get_type() == ClientStatus.MSG_TYPE_CLIENT_STATUS
+    assert msg.get(ClientStatus.KEY_STATUS) == ClientStatus.ONLINE
+    with pytest.raises(ValueError, match="interval"):
+        HeartbeatSender(LoopbackCommManager(fabric, 1), 1, 0.0)
+
+
+def _direct_server(trainer, train, worker_num=3, **kwargs):
+    """A server on a loopback comm nobody reads — rounds are driven by
+    calling the handlers directly, so timing never enters the test."""
+    _, flat, desc = init_template(trainer, train.arrays, 8)
+    fabric = LoopbackFabric(worker_num + 1)
+    server = FedAvgServerManager(
+        LoopbackCommManager(fabric, 0), worker_num, 100, flat, desc,
+        round_timeout=60.0, **kwargs,
+    )
+    return server, flat
+
+
+def _upload(server, worker, round_idx, flat):
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, worker + 1, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, np.array(flat))
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, 1.0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
+    server._on_model_from_client(msg)
+
+
+def test_exclude_after_boundary_miss_reset_on_contact():
+    """A worker missing exactly ``exclude_after - 1`` CONSECUTIVE rounds is
+    never excluded, and an upload in between resets the count — only
+    exclude_after consecutive silent misses exclude."""
+    trainer, train = _blob_setup(workers=3)
+    server, flat = _direct_server(trainer, train, exclude_after=2)
+
+    # round 0: worker 2 misses (1 of 2 consecutive) -> NOT excluded
+    _upload(server, 0, 0, flat)
+    _upload(server, 1, 0, flat)
+    server._round_timed_out(0)
+    assert server.round_idx == 1
+    assert server.aggregator.is_live(2)
+    assert server.status.snapshot().get(3) != ClientStatus.OFFLINE
+    assert server._miss_counts == {2: 1}
+
+    # round 1: worker 2 uploads -> consecutive-miss count resets
+    _upload(server, 0, 1, flat)
+    _upload(server, 1, 1, flat)
+    _upload(server, 2, 1, flat)
+    assert server.round_idx == 2
+    assert server._miss_counts == {}
+
+    # rounds 2+3: two consecutive silent misses -> excluded exactly then
+    _upload(server, 0, 2, flat)
+    _upload(server, 1, 2, flat)
+    server._round_timed_out(2)
+    assert server.aggregator.is_live(2)  # boundary: exclude_after - 1
+    _upload(server, 0, 3, flat)
+    _upload(server, 1, 3, flat)
+    server._round_timed_out(3)
+    assert not server.aggregator.is_live(2)
+    assert server.status.snapshot()[3] == ClientStatus.OFFLINE
+    assert server.aggregator.excluded_workers() == [2]
+
+
+def test_slow_worker_with_fresh_heartbeat_not_marched_to_exclusion():
+    trainer, train = _blob_setup(workers=2)
+    server, flat = _direct_server(trainer, train, worker_num=2,
+                                  exclude_after=1, heartbeat_timeout=30.0)
+    # worker 1 heartbeats (fresh contact) but misses the round deadline
+    hb = Message(ClientStatus.MSG_TYPE_CLIENT_STATUS, 2, 0)
+    hb.add_params(ClientStatus.KEY_STATUS, ClientStatus.ONLINE)
+    server._on_client_status(hb)
+    _upload(server, 0, 0, flat)
+    server._round_timed_out(0)
+    # alive-but-late: labeled SLOW, dropped from the round, NOT excluded
+    # (even with exclude_after=1)
+    assert server.round_idx == 1
+    assert server.aggregator.is_live(1)
+    assert server.status.snapshot()[2] == ClientStatus.SLOW
+    assert server._miss_counts == {}
+
+
+# ---------------------------------------------------------------------------
+# crash-recoverable server state
+# ---------------------------------------------------------------------------
+
+
+def test_server_checkpoint_roundtrip(tmp_path):
+    from fedml_tpu.obs.checkpoint import RoundCheckpointer
+
+    ckptr = RoundCheckpointer(tmp_path, keep=2)
+    state = {
+        "round_idx": 5,
+        "global_flat": np.arange(16, dtype=np.uint8),
+        "miss_counts": {"2": 1},
+        "status": {"1": "ONLINE", "3": "OFFLINE"},
+        "aggregator": {
+            "wsum": 0.0,
+            "live": [0, 1],
+            "uploaded": [],
+            "excluded": [2],
+            "sample_num": {},
+            "acc": np.zeros(4, np.float64),
+        },
+    }
+    for r in (3, 4, 5):
+        ckptr.save_server(r, {**state, "round_idx": r})
+    assert ckptr.latest_server_round() == 5
+    # gc kept only the last `keep` snapshots
+    assert len(list(tmp_path.glob("server_round_*.json"))) == 2
+    out = ckptr.restore_server()
+    assert out["round_idx"] == 5
+    np.testing.assert_array_equal(out["global_flat"], state["global_flat"])
+    np.testing.assert_array_equal(out["aggregator"]["acc"],
+                                  state["aggregator"]["acc"])
+    assert out["aggregator"]["excluded"] == [2]
+    assert out["status"] == state["status"]
+    with pytest.raises(FileNotFoundError):
+        RoundCheckpointer(tmp_path / "empty").restore_server()
+
+
+def test_server_restore_from_checkpoint_state(tmp_path):
+    from fedml_tpu.obs.checkpoint import RoundCheckpointer
+
+    trainer, train = _blob_setup(workers=3)
+    ckptr = RoundCheckpointer(tmp_path)
+    server, flat = _direct_server(trainer, train, checkpointer=ckptr,
+                                  exclude_after=1)
+    # round 0 closes with worker 2 missing -> excluded; checkpoint written
+    _upload(server, 0, 0, flat)
+    _upload(server, 1, 0, flat)
+    server._round_timed_out(0)
+    assert server.round_idx == 1
+    assert ckptr.latest_server_round() == 1
+
+    # a fresh server restores the full round state
+    server2, _ = _direct_server(trainer, train, checkpointer=ckptr)
+    server2.restore_from_checkpoint()
+    assert server2.round_idx == 1
+    np.testing.assert_array_equal(server2.global_flat, server.global_flat)
+    assert server2.aggregator.live_workers() == [0, 1]
+    assert server2.aggregator.excluded_workers() == [2]
+    assert server2.status.snapshot()[3] == ClientStatus.OFFLINE
+    with pytest.raises(ValueError, match="checkpointer"):
+        FedAvgServerManager.restore_from_checkpoint(
+            _direct_server(trainer, train)[0]
+        )
+
+
+def test_robust_aggregator_snapshot_carries_noise_round():
+    from fedml_tpu.algorithms.robust_distributed import (
+        RobustDistAggregator,
+        RobustDistConfig,
+    )
+
+    cfg = RobustDistConfig(rule="mean", norm_bound=1.0, dp_stddev=0.1,
+                           dp_seed=9)
+    agg = RobustDistAggregator(2, cfg)
+    base = np.zeros(4, np.float32)
+    agg.get_global = lambda: base.view(np.uint8)
+    for r in range(3):  # close 3 rounds -> noise-key round advances to 3
+        agg.add_local_trained_result(0, np.ones(4, np.float32).view(np.uint8),
+                                     1.0)
+        agg.aggregate()
+    snap = agg.snapshot_state()
+    assert snap["robust_round"] == 3
+
+    agg2 = RobustDistAggregator(2, cfg)
+    agg2.get_global = lambda: base.view(np.uint8)
+    agg2.restore_state(snap)
+    # the restored tally continues the SAME noise schedule: round 3's
+    # output matches an uninterrupted aggregator's round 3 bit-for-bit
+    agg.add_local_trained_result(0, np.ones(4, np.float32).view(np.uint8), 1.0)
+    agg2.add_local_trained_result(0, np.ones(4, np.float32).view(np.uint8), 1.0)
+    np.testing.assert_array_equal(agg.aggregate(), agg2.aggregate())
+
+
+# ---------------------------------------------------------------------------
+# new fault kinds
+# ---------------------------------------------------------------------------
+
+
+class _Recorder:
+    def __init__(self):
+        self.got = []
+
+    def receive_message(self, msg_type, msg):
+        self.got.append((msg_type, msg.get_sender_id()))
+
+
+def test_recv_drop_fault_blocks_delivery_but_not_finished():
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(recv_drop=1.0), rank=1)
+    rec = _Recorder()
+    mgr.add_observer(rec)
+    t = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    t.start()
+    try:
+        m = Message(2, 0, 1)
+        m.add_params("x", 1)
+        fabric.post(m)
+        fin = Message(2, 0, 1)
+        fin.add_params("finished", 1)
+        fabric.post(fin)
+        deadline = time.monotonic() + 2.0
+        while not rec.got and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # only the protected finished message got through
+        assert len(rec.got) == 1
+        assert ("recv_drop", 2, 1) in mgr.applied
+    finally:
+        mgr.stop_receive_message()
+        t.join(timeout=5)
+
+
+def test_recv_delay_fault_delivers_late():
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(recv_delay=0.3), rank=1)
+    rec = _Recorder()
+    mgr.add_observer(rec)
+    t = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    t.start()
+    try:
+        m = Message(2, 0, 1)
+        m.add_params("x", 1)
+        fabric.post(m)
+        time.sleep(0.1)
+        assert rec.got == []  # held on the timer thread
+        deadline = time.monotonic() + 3.0
+        while not rec.got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.got == [(2, 0)]
+    finally:
+        mgr.stop_receive_message()
+        t.join(timeout=5)
+
+
+def test_recv_fault_observer_removal_unwraps_shim():
+    fabric = LoopbackFabric(2)
+    inner = LoopbackCommManager(fabric, 1)
+    mgr = FaultyCommManager(inner, FaultSpec(recv_drop=1.0), rank=1)
+    rec = _Recorder()
+    mgr.add_observer(rec)
+    assert len(inner._observers) == 1
+    mgr.remove_observer(rec)
+    assert inner._observers == []
+
+
+def test_crashed_rank_stays_dead_for_round_free_sends():
+    """Once the crash fault fires, EVERY later send from the rank raises —
+    including round-index-free messages like heartbeats (a dead process
+    sends nothing; without this, a crashed client would keep heartbeating
+    ONLINE and could never be excluded)."""
+    fabric = LoopbackFabric(2)
+    mgr = FaultyCommManager(LoopbackCommManager(fabric, 1),
+                            FaultSpec(crash_round=0), rank=1)
+    hb = Message(ClientStatus.MSG_TYPE_CLIENT_STATUS, 1, 0)
+    hb.add_params(ClientStatus.KEY_STATUS, ClientStatus.ONLINE)
+    mgr.send_message(hb)  # no round idx, not crashed yet: passes through
+    assert fabric.queues[0].qsize() == 1
+    doomed = Message(3, 1, 0)
+    doomed.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+    with pytest.raises(InjectedCrash):
+        mgr.send_message(doomed)
+    with pytest.raises(InjectedCrash):
+        mgr.send_message(hb)  # round-free, but the rank is dead now
+    assert fabric.queues[0].qsize() == 1
+
+
+def test_parse_fault_spec_new_kinds_and_unknown_error():
+    spec = parse_fault_spec("1:recv_drop=0.5,recv_delay=0.2@0.7;0:crash=3;"
+                            "2:fail=0.25")
+    assert spec[1].recv_drop == 0.5
+    assert spec[1].recv_delay == 0.2
+    assert spec[1].recv_delay_prob == 0.7
+    assert spec[0].crash_round == 3
+    assert spec[0].active
+    assert spec[2].fail == 0.25
+    with pytest.raises(ValueError) as ei:
+        parse_fault_spec("1:bogus=1")
+    # the error names the full valid set
+    for kind in ("drop", "delay", "dup", "corrupt", "fail", "recv_drop",
+                 "recv_delay", "crash"):
+        assert kind in str(ei.value)
+
+
+def test_fault_spec_validation():
+    with pytest.raises(ValueError, match="recv_drop"):
+        FaultSpec(recv_drop=1.5)
+    with pytest.raises(ValueError, match="recv_delay"):
+        FaultSpec(recv_delay=-1.0)
+    assert not FaultSpec().active
+    assert FaultSpec(crash_round=0).active
+    assert FaultSpec(fail=0.1).active
+    assert FaultSpec(recv_drop=0.1).active
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke guard
+# ---------------------------------------------------------------------------
+
+
+def test_ft_smoke_tool_runs():
+    """tools/ft_smoke.py is the tier-1 guard the docs point at — run it
+    in-process (mirrors the wire/pack/robust smokes' wiring)."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "ft_smoke.py"
+    spec = importlib.util.spec_from_file_location("ft_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

@@ -1,0 +1,144 @@
+"""Fault-tolerance smoke: the crash-recovery and retry/heartbeat
+bit-identity contract of the distributed runtime (docs/ROBUSTNESS.md
+"Failure recovery"), run tier-1 and in-process.
+
+Three arms over the same 4-worker loopback FedAvg run (upload arrival
+order pinned by a rank-ordered uplink fabric so f64 fold order is
+deterministic):
+
+1. **Reference** — uninterrupted run, per-round globals recorded.
+2. **Crash + resume** — the server rank carries an injected
+   ``crash=CRASH_AT`` fault (comm/faults.py): it dies on the round-CRASH_AT
+   sync fan-out, AFTER checkpointing that round's close
+   (obs/checkpoint.py ``save_server``). A fresh server+clients run then
+   resumes from the checkpoint, re-broadcasts round CRASH_AT, and the
+   remaining rounds plus the final global model must be BIT-IDENTICAL to
+   the reference.
+3. **Retries + heartbeats, fault-free** — a RetryPolicy armed on every
+   rank and per-client heartbeat threads running must not perturb results:
+   bit-identical to the reference (the zero-overhead-when-unneeded
+   contract of the recovery planes).
+
+    JAX_PLATFORMS=cpu python tools/ft_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 6
+WORKERS = 4
+CRASH_AT = 3
+
+
+def main(argv=None) -> int:
+    import shutil
+    import tempfile
+
+    import jax
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.comm.faults import FaultSpec, InjectedCrash
+    from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
+    from fedml_tpu.comm.retry import RetryPolicy
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(
+        n_clients=WORKERS, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+
+    def run(per_round: dict, **kw):
+        fabric = OrderedUplinkFabric(
+            WORKERS + 1, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        )
+        return run_distributed_fedavg(
+            trainer, train, worker_num=WORKERS, round_num=ROUNDS,
+            batch_size=8,
+            make_comm=lambda r: LoopbackCommManager(fabric, r),
+            on_round_done=lambda r, v: per_round.__setitem__(
+                r, [np.asarray(l).copy() for l in jax.tree.leaves(v)]
+            ),
+            **kw,
+        )
+
+    def assert_rounds_equal(rounds, label):
+        for r, leaves in rounds.items():
+            for a, b in zip(leaves, ref_rounds[r]):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"{label}: round {r} differs from reference"
+                )
+
+    def assert_final_equal(final, label):
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(ref_final)):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b),
+                err_msg=f"{label}: final model differs from reference",
+            )
+
+    # -- arm 1: uninterrupted reference --------------------------------------
+    ref_rounds: dict = {}
+    ref_final = run(ref_rounds)
+    assert sorted(ref_rounds) == list(range(ROUNDS))
+
+    # -- arm 2: server killed mid-run, restarted from checkpoint -------------
+    ckpt = tempfile.mkdtemp(prefix="ft_smoke_ckpt_")
+    try:
+        crashed: dict = {}
+        try:
+            run(crashed, checkpoint_dir=ckpt,
+                fault_specs={0: FaultSpec(crash_round=CRASH_AT)})
+            raise AssertionError("injected server crash never fired")
+        except InjectedCrash:
+            pass
+        assert sorted(crashed) == list(range(CRASH_AT)), (
+            f"crashed run closed rounds {sorted(crashed)}; expected "
+            f"0..{CRASH_AT - 1}"
+        )
+        resumed: dict = {}
+        resumed_final = run(resumed, checkpoint_dir=ckpt, resume=True)
+        assert sorted(resumed) == list(range(CRASH_AT, ROUNDS)), (
+            f"resumed run closed rounds {sorted(resumed)}; expected "
+            f"{CRASH_AT}..{ROUNDS - 1}"
+        )
+        assert_rounds_equal(crashed, "crashed arm")
+        assert_rounds_equal(resumed, "resumed arm")
+        assert_final_equal(resumed_final, "crash+resume")
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+
+    # -- arm 3: retries + heartbeats on, fault-free --------------------------
+    ft_rounds: dict = {}
+    ft_final = run(
+        ft_rounds,
+        retry_policy=RetryPolicy(max_attempts=3, base_delay=0.01),
+        heartbeat_interval=0.05,
+    )
+    assert sorted(ft_rounds) == list(range(ROUNDS))
+    assert_rounds_equal(ft_rounds, "retries+heartbeats arm")
+    assert_final_equal(ft_final, "retries+heartbeats")
+
+    print(
+        f"ft smoke OK: {ROUNDS} rounds x {WORKERS} workers — server crashed "
+        f"at round {CRASH_AT} and resumed from checkpoint bit-identically; "
+        "retries+heartbeats arm bit-identical to the plain wire path"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
