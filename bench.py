@@ -626,6 +626,78 @@ def bench_ft_overhead(n_rounds: int = 4):
     }
 
 
+def bench_async_ab(n_rounds: int = 3):
+    """Barrier-free server A/B (docs/PERFORMANCE.md "Barrier-free
+    aggregation"): loopback uploads/sec and models-emitted/sec for the
+    three server execution modes at fan-in 4 and 16 — sync round barrier,
+    buffered-async (buffer_goal = fan-in/2, so two model versions emit per
+    sync-round's worth of uploads), and a 2-tier aggregation tree
+    (sqrt(fan-in) edges x sqrt(fan-in) clients). The headline is
+    uploads/sec SCALING WITH TREE FAN-IN: the root folds O(tiers)
+    partials, not O(clients) models. Returns probe metrics for ``extra``
+    (top-level platform/cpu_fallback stamps label a CPU-serving run)."""
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.async_agg.tree import run_tree_fedavg_loopback
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import metrics as metricslib
+
+    out = {}
+    tree_shapes = {4: (2, 2), 16: (4, 4)}
+    for fan_in in (4, 16):
+        workers = fan_in
+        train, _ = gaussian_blobs(n_clients=workers, samples_per_client=24,
+                                  num_classes=4, seed=0)
+        trainer = ClientTrainer(
+            module=LogisticRegression(num_classes=4),
+            optimizer=optax.sgd(0.1), epochs=1,
+        )
+
+        def timed(fn):
+            fn()  # warm: compile + thread spinup
+            t0 = time.perf_counter()
+            fn()
+            return time.perf_counter() - t0
+
+        dt = timed(lambda: run_distributed_fedavg_loopback(
+            trainer, train, worker_num=workers, round_num=n_rounds,
+            batch_size=8,
+        ))
+        out[f"async_f{fan_in}_sync_uploads_per_sec"] = round(
+            n_rounds * workers / dt, 1)
+        out[f"async_f{fan_in}_sync_models_per_sec"] = round(n_rounds / dt, 2)
+
+        stats: dict = {}
+
+        def run_async():
+            stats.clear()
+            return run_distributed_fedavg_loopback(
+                trainer, train, worker_num=workers, round_num=n_rounds,
+                batch_size=8, server_mode="async",
+                buffer_goal=max(1, workers // 2), async_stats=stats,
+            )
+
+        dt = timed(run_async)
+        uploads = sum(r[metricslib.ASYNC_ARRIVALS]
+                      for r in stats.get("rounds", []))
+        out[f"async_f{fan_in}_async_uploads_per_sec"] = round(uploads / dt, 1)
+        out[f"async_f{fan_in}_async_models_per_sec"] = round(
+            stats["totals"][metricslib.ASYNC_MODELS_EMITTED] / dt, 2)
+
+        dt = timed(lambda: run_tree_fedavg_loopback(
+            trainer, train, tree_shapes[fan_in], n_rounds, 8,
+        ))
+        out[f"async_f{fan_in}_tree_uploads_per_sec"] = round(
+            n_rounds * workers / dt, 1)
+        out[f"async_f{fan_in}_tree_models_per_sec"] = round(n_rounds / dt, 2)
+    return out
+
+
 def bench_shard_ab(peak_tflops, fallback_reason):
     """Sharded-client-model A/B (docs/PERFORMANCE.md "Sharded client
     models"). On a real multi-chip TPU: the benched LM round with the
@@ -1105,6 +1177,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_ft_overhead())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["ft_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_async_probe"
+    try:
+        pipeline_extra.update(bench_async_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["async_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_shard_probe"
     try:

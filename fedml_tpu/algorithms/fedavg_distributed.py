@@ -350,12 +350,33 @@ class FedAvgServerManager(ServerManager):
             )
         self.client_num_in_total = client_num_in_total or worker_num
         self.on_round_done = on_round_done
+        # stale-round uploads from live workers (a straggler's model from an
+        # already-closed round) are discarded by the sync protocol — counted
+        # here so the loss is visible (Comm/StaleUploads in comm_stats
+        # totals; the async server folds them weighted instead)
+        self.stale_uploads = 0
 
     def _model_payload(self, rank: int):
         """Model payload for ``rank`` — the wire-format seam. Base sends the
         packed flat byte vector; the mobile server (fedavg_mobile.py) sends
         the reference's nested-list JSON to its ``is_mobile`` ranks."""
         return self.global_flat
+
+    def _round_cohort(self):
+        """Client-index assignment for the current round's downlink: worker
+        rank w trains as client ``cohort[w - 1]``. The tree-root server
+        (async_agg/tree.py) returns None — its direct receivers are edge
+        aggregators, and the leaf tiers derive the same assignment from the
+        shared ``rnglib.sample_clients`` schedule themselves."""
+        return rnglib.sample_clients(self.round_idx, self.client_num_in_total,
+                                     self.worker_num)
+
+    def _sync_extra_params(self) -> dict:
+        """Extra header params stamped on every downlink sync — the async
+        server adds the explicit global-model version here (clients train
+        against a version, not a sync count). Header-only scalars: they ride
+        the per-receiver head, never the shared payload frame."""
+        return {}
 
     def _decode_upload(self, msg: Message) -> np.ndarray:
         """Inverse seam: a client upload back to the flat byte vector."""
@@ -392,6 +413,8 @@ class FedAvgServerManager(ServerManager):
                 # so a duplicated/replayed downlink leg (comm/faults.py dup)
                 # cannot desynchronize a client's round counter forever
                 msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self.round_idx)
+                for k, v in self._sync_extra_params().items():
+                    msg.add_params(k, v)
                 if include_desc:
                     msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                    self.model_desc)
@@ -410,6 +433,8 @@ class FedAvgServerManager(ServerManager):
                                    payloads[w])
                     msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
                                    self.round_idx)
+                    for k, v in self._sync_extra_params().items():
+                        msg.add_params(k, v)
                     if include_desc:
                         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                        self.model_desc)
@@ -448,9 +473,7 @@ class FedAvgServerManager(ServerManager):
         # cohort keyed by round_idx (not literal 0) so a server restarted
         # from a checkpoint re-broadcasts ITS round — clients train as that
         # round (authoritative round-index sync) and resume is idempotent
-        cohort = rnglib.sample_clients(self.round_idx,
-                                       self.client_num_in_total,
-                                       self.worker_num)
+        cohort = self._round_cohort()
         self._fanout_model(
             MyMessage.MSG_TYPE_S2C_INIT_CONFIG,
             [w + 1 for w in range(self.worker_num)],
@@ -523,10 +546,15 @@ class FedAvgServerManager(ServerManager):
                 return
             if upload_round is not None and int(upload_round) != current:
                 # a straggler's upload from a timed-out round: one-round-stale
-                # model, must not pollute the current tally
+                # model, must not pollute the current tally. Counted (not
+                # silent): Comm/StaleUploads is the observability baseline
+                # the async server's staleness weighting builds on.
+                self.stale_uploads += 1
                 logging.info(
-                    "ignoring stale upload from worker %d (round %s, now %d)",
-                    sender, upload_round, current,
+                    "discarding stale upload from worker %d (upload_round=%s,"
+                    " current=%d; Comm/StaleUploads=%d this run — the async "
+                    "server mode folds these with a staleness weight instead)",
+                    sender, upload_round, current, self.stale_uploads,
                 )
                 return
             self.status.update(sender, ClientStatus.ONLINE)
@@ -643,7 +671,7 @@ class FedAvgServerManager(ServerManager):
                                finished=True)
             self.finish()
             return
-        cohort = rnglib.sample_clients(self.round_idx, self.client_num_in_total, self.worker_num)
+        cohort = self._round_cohort()
         self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                            [w + 1 for w in self.aggregator.live_workers()],
                            cohort=cohort)
@@ -722,6 +750,11 @@ class FedAvgClientManager(ClientManager):
         # silo mesh (algorithms/cross_silo.py) instead of single-device
         self._local_train = local_train_fn or jax.jit(make_local_train(trainer))
         self._round = 0
+        # rng identity on the wire: ranks are fabric-local, so two leaves in
+        # different tiers of an aggregation tree can share a rank — the tree
+        # harness points rng_rank at the GLOBAL leaf number instead so their
+        # local-train key chains never collide (flat runs: rng_rank == rank)
+        self.rng_rank = rank
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
@@ -753,6 +786,13 @@ class FedAvgClientManager(ClientManager):
         if msg.get("finished"):
             self.finish()
             return
+        # the explicit model-version stamp (async server mode,
+        # docs/PERFORMANCE.md "Barrier-free aggregation"): remembered here
+        # and ECHOED on the upload, so the server's staleness weight is
+        # computed from the version this client verifiably trained against
+        # (sync servers stamp no version and get no echo)
+        version = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
+        self._model_version = None if version is None else int(version)
         ridx = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         if ridx is not None:
             # train AS the server's round, not as "however many syncs this
@@ -770,13 +810,17 @@ class FedAvgClientManager(ClientManager):
         )
         batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
         new_vars, _ = self._local_train(
-            variables, batches, jax.random.key(self.rank * 100003 + self._round)
+            variables, batches,
+            jax.random.key(self.rng_rank * 100003 + self._round),
         )
         self._round += 1
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         self._fill_upload(out, new_vars, variables)
         out.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(weights[0]))
         out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round - 1)
+        if getattr(self, "_model_version", None) is not None:
+            out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION,
+                           self._model_version)
         self.send_message(out)
 
 
@@ -999,6 +1043,10 @@ def run_distributed_fedavg(
     checkpoint_dir=None,
     checkpoint_every: int = 1,
     resume: bool = False,
+    server_mode: str = "sync",
+    buffer_goal: int | None = None,
+    staleness_weight: str = "const",
+    async_stats: dict | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -1029,7 +1077,38 @@ def run_distributed_fedavg(
     restores the latest snapshot and re-broadcasts its round — clients
     re-train AS that round, so a crashed-and-restarted run is
     bit-identical to an uninterrupted one (tools/ft_smoke.py).
+
+    Server execution mode (docs/PERFORMANCE.md "Barrier-free aggregation"):
+    ``server_mode="async"`` swaps in the FedBuff-style buffered-async
+    server (fedml_tpu/async_agg): uploads fold on arrival with a
+    ``staleness_weight`` decay (const | poly:a | hinge:a,b), a new global
+    model is emitted every ``buffer_goal`` arrivals (default: the worker
+    count) with no round barrier, and ``round_num`` counts EMITTED models.
+    ``async_stats`` (a caller dict) receives per-emission Async/* records.
+    With ``buffer_goal == worker_num`` and the constant weight the async
+    path reproduces the sync streaming path bit-for-bit
+    (tools/async_smoke.py holds the contract). The hierarchical-tree mode
+    has its own harness (async_agg.tree.run_tree_fedavg_loopback).
     Returns the final global variables."""
+    if server_mode not in ("sync", "async"):
+        raise ValueError(
+            f"unknown server_mode {server_mode!r}: expected 'sync' or "
+            "'async' (the hierarchical tree mode runs through "
+            "async_agg.tree.run_tree_fedavg_loopback — its process topology "
+            "is a tree of comm fabrics, not this harness's flat fan-out)"
+        )
+    if server_mode == "async":
+        if server_cls is not None or client_cls_for_rank is not None:
+            raise ValueError(
+                "server_mode='async' does not compose with custom manager "
+                "classes (e.g. is_mobile's JSON wire format)"
+            )
+        if round_timeout is not None:
+            raise ValueError(
+                "server_mode='async' has no round barrier, so the elastic "
+                "round_timeout does not apply — drop it (slow workers just "
+                "fold late, staleness-weighted)"
+            )
     if codec is not None and (server_cls is not None
                               or client_cls_for_rank is not None):
         raise ValueError(
@@ -1102,6 +1181,37 @@ def run_distributed_fedavg(
 
             return make
 
+    if server_mode == "async":
+        # remap the selected sync server class onto its barrier-free
+        # counterpart (fedml_tpu/async_agg): same wire seams, async tally
+        from fedml_tpu.async_agg.server import (
+            AsyncCompressedFedAvgServerManager,
+            AsyncFedAvgServerManager,
+            AsyncRobustFedAvgServerManager,
+        )
+
+        async_cls = {
+            None: AsyncFedAvgServerManager,
+            CompressedFedAvgServerManager: AsyncCompressedFedAvgServerManager,
+        }
+        if robust_config is not None:
+            from fedml_tpu.algorithms.robust_distributed import (
+                RobustCompressedFedAvgServerManager,
+                RobustFedAvgServerManager,
+            )
+
+            if server_cls is RobustCompressedFedAvgServerManager:
+                raise NotImplementedError(
+                    "server_mode='async' composes with a codec OR a robust "
+                    "defense, not both at once yet"
+                )
+            async_cls[RobustFedAvgServerManager] = AsyncRobustFedAvgServerManager
+        server_cls = async_cls[server_cls]
+        server_kwargs = {**(server_kwargs or {}),
+                         "buffer_goal": buffer_goal,
+                         "staleness_weight": staleness_weight,
+                         "async_stats": async_stats}
+
     results: dict[str, np.ndarray] = {}
 
     def _done(r, f):
@@ -1159,14 +1269,19 @@ def run_distributed_fedavg(
         for hb in heartbeats:
             hb.stop()
     if comm_stats is not None:
+        from fedml_tpu.obs import metrics as metricslib
+
         if codec is not None:
             comm_stats["totals"] = server.accountant.totals()
         if retry_policy is not None:
-            from fedml_tpu.obs import metrics as metricslib
-
             comm_stats.setdefault("totals", {})[metricslib.COMM_RETRY_COUNT] = (
                 retry_stats()["retries"] - retries_before
             )
+        comm_stats.setdefault("totals", {})[metricslib.COMM_STALE_UPLOADS] = (
+            int(getattr(server, "stale_uploads", 0))
+        )
+    if async_stats is not None and hasattr(server, "async_totals"):
+        async_stats["totals"] = server.async_totals()
     return unpack_pytree(results["final"], desc)
 
 

@@ -1,0 +1,370 @@
+"""Buffered-asynchronous FedAvg server: fold-on-arrival, emit-every-K.
+
+FedBuff (Nguyen et al., 2022) semantics over this repo's streaming wire
+path (PR 5): there is NO round barrier. Every client upload folds into the
+ONE f64 accumulator the moment it arrives, weighted ``s(staleness) * n``
+(:mod:`fedml_tpu.async_agg.staleness`), and the server emits a new global
+model every ``buffer_goal`` arrivals — ``round_num`` counts emitted model
+VERSIONS, not synchronized rounds. Stale uploads are folded (down-
+weighted), never discarded; duplicate/replayed uploads (comm/faults.py
+``dup``) are absorbed by a per-sender (version) idempotence guard.
+
+Dispatch discipline (how the barrier disappears without deadlocking):
+
+- an upload that trained an OLD version gets the current model back
+  immediately — the worker never idles waiting for a round to close;
+- an upload that trained the CURRENT version parks its worker (re-training
+  the same version would reproduce the same update bit-for-bit);
+- an emission bumps the version and dispatches the new model to every
+  parked worker plus the triggering uploader.
+
+With ``buffer_goal == worker_num`` every worker parks before the buffer
+fills, so the emission broadcast goes to the full cohort — the sync
+protocol re-emerges as a special case, and with the constant staleness
+weight the fold arithmetic is IDENTICAL, so async-with-full-buffer is
+bit-identical to the sync streaming server (tools/async_smoke.py, tier-1).
+
+Every downlink stamps the model version it carries
+(``Message.MSG_ARG_KEY_MODEL_VERSION``, alongside the authoritative
+``round_idx`` the base client trains as), and crash-resume snapshots the
+mid-window arrival counter + idempotence guard through the PR 8
+``RoundCheckpointer`` server-snapshot path.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    CompressedDistAggregator,
+    CompressedFedAvgServerManager,
+    FedAvgDistAggregator,
+    FedAvgServerManager,
+    MyMessage,
+)
+from fedml_tpu.algorithms.robust_distributed import (
+    RobustDistAggregator,
+    _RobustServerMixin,
+)
+from fedml_tpu.async_agg.staleness import make_staleness_fn
+from fedml_tpu.comm.message import Message
+from fedml_tpu.obs import metrics as metricslib
+from fedml_tpu.obs import trace
+
+
+class _AsyncTallyMixin:
+    """Barrier-free tally surface over any streaming aggregator: versioned
+    fold-on-arrival with a per-sender idempotence guard, an arrival counter
+    driving emissions, and crash-recoverable window state. Mixed in FIRST
+    over :class:`FedAvgDistAggregator` (or its compressed/robust
+    subclasses) so ``self._fold``/``self._finish`` resolve to the wrapped
+    arithmetic — the async weight simply rides the fold's sample-number
+    slot, which is why every defended/encoded fold composes unchanged."""
+
+    def _init_async(self) -> None:
+        self.arrivals = 0  # folds since the last emission
+        self.last_folded: dict[int, int] = {}  # worker -> newest version folded
+
+    def fold_async(self, index: int, payload, weight: float,
+                   upload_version: int) -> bool:
+        """Fold one upload with its staleness-resolved ``weight``. Returns
+        False when the (sender, version) pair was already folded — a
+        duplicated or replayed wire leg — which must NOT advance the
+        arrival counter (an attacker or a flaky transport could otherwise
+        pump emissions)."""
+        with self._lock:
+            last = self.last_folded.get(index)
+            if last is not None and upload_version <= last:
+                return False
+            self._fold(payload, weight)
+            self.last_folded[index] = int(upload_version)
+            self.arrivals += 1
+            return True
+
+    def emit(self) -> np.ndarray:
+        """Close the buffer window: divide the accumulator and reset the
+        arrival counter. The caller (server manager) bumps the version."""
+        with self._lock:
+            self.arrivals = 0
+            return self._finish()
+
+    def snapshot_state(self) -> dict:
+        out = super().snapshot_state()
+        out["arrivals"] = int(self.arrivals)
+        out["last_folded"] = {str(k): int(v)
+                              for k, v in self.last_folded.items()}
+        return out
+
+    def restore_state(self, state: dict) -> None:
+        super().restore_state(state)
+        self.arrivals = int(state.get("arrivals", 0))
+        self.last_folded = {int(k): int(v)
+                            for k, v in state.get("last_folded", {}).items()}
+
+
+class AsyncFedAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
+    """Dense async tally (the default)."""
+
+    def __init__(self, worker_num: int):
+        super().__init__(worker_num)
+        self._init_async()
+
+
+class AsyncCompressedFedAggregator(_AsyncTallyMixin, CompressedDistAggregator):
+    """Async tally over encoded uploads: each EncodedUpdate scatter-folds
+    into the dense accumulator on arrival, staleness weight included."""
+
+    def __init__(self, worker_num: int, codec):
+        super().__init__(worker_num, codec)
+        self._init_async()
+
+
+class AsyncRobustFedAggregator(_AsyncTallyMixin, RobustDistAggregator):
+    """Async tally with the streaming defense folded into the arrival path:
+    clip-against-last-emitted + non-finite rejection per upload, seeded
+    weak-DP noise per EMISSION (the noise-key counter advances per emitted
+    version). Mean rule only — order-statistic rules need a closed cohort
+    stack, which a barrier-free window does not have."""
+
+    def __init__(self, worker_num: int, config, model_desc: str | None = None):
+        if config.rule != "mean" or config.reservoir_k:
+            raise NotImplementedError(
+                "async server mode supports the streaming 'mean' defense "
+                "(clip + DP noise); order-statistic rules "
+                f"({config.rule!r} / reservoir_k={config.reservoir_k}) need "
+                "a closed cohort stack and a round barrier"
+            )
+        super().__init__(worker_num, config, model_desc=model_desc)
+        self._init_async()
+
+
+class AsyncFedAvgServerManager(FedAvgServerManager):
+    """Barrier-free server protocol (see module docstring).
+
+    ``round_idx`` is reinterpreted as the GLOBAL MODEL VERSION (number of
+    emitted models); ``round_num`` as the number of versions to emit.
+    ``on_round_done`` fires once per emission with (version, flat model).
+    The elastic round timeout, the buffered A/B tally, and the exclusion
+    march are sync-barrier machinery and are rejected loudly — liveness in
+    async mode is heartbeats-only (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, *args, buffer_goal: int | None = None,
+                 staleness_weight: str = "const",
+                 async_stats: dict | None = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.round_timeout is not None:
+            raise ValueError(
+                "async server mode has no round barrier: the elastic "
+                "round_timeout does not apply"
+            )
+        if self.buffered_aggregation:
+            raise ValueError(
+                "async server mode has no buffered A/B arm: the tally is "
+                "streaming by construction (the sync server keeps the "
+                "buffered oracle)"
+            )
+        self.buffer_goal = int(buffer_goal) if buffer_goal else self.worker_num
+        if not (1 <= self.buffer_goal <= self.worker_num):
+            raise ValueError(
+                f"buffer_goal must be in [1, worker_num={self.worker_num}], "
+                f"got {self.buffer_goal}: a window larger than the worker "
+                "pool can never fill (every worker parks after its fold) — "
+                "the server would deadlock"
+            )
+        self.staleness_weight = str(staleness_weight)
+        self._staleness_fn = make_staleness_fn(self.staleness_weight)
+        self._async_stats = async_stats
+        self._parked: set[int] = set()  # workers awaiting the next emission
+        # per-emission-window counters + run totals (Async/* metrics)
+        self._window = {"stale": 0, "dup": 0, "staleness_sum": 0}
+        self._totals = {"stale": 0, "dup": 0, "emitted": 0}
+        self.aggregator = self._make_async_aggregator()
+
+    def _make_async_aggregator(self):
+        return AsyncFedAggregator(self.worker_num)
+
+    def _sync_extra_params(self) -> dict:
+        # the explicit version stamp: clients train against version
+        # round_idx and the upload's echoed round index is the version the
+        # staleness weight is computed from
+        return {Message.MSG_ARG_KEY_MODEL_VERSION: self.round_idx}
+
+    # -- the barrier-free receive path ---------------------------------------
+
+    def _on_model_from_client(self, msg: Message) -> None:
+        from fedml_tpu.comm.status import ClientStatus
+
+        sender = msg.get_sender_id()
+        flat = self._decode_upload(msg)
+        n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        # prefer the client's explicit version echo (the downlink stamp it
+        # verifiably trained against); the authoritative round index it
+        # trained AS is the compatible fallback — identical in value, but
+        # only the echo survives a future protocol where the two diverge
+        u = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
+        if u is None:
+            u = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        with self._round_lock:
+            current = self.round_idx
+            if not self.aggregator.is_live(sender - 1):
+                logging.info("ignoring upload from non-live worker %d", sender)
+                return
+            self.status.update(sender, ClientStatus.ONLINE)
+            u = current if u is None else int(u)
+            if u > current:
+                logging.warning(
+                    "worker %d uploaded for version %d ahead of the server's "
+                    "%d (protocol bug or replayed future leg); folding as "
+                    "fresh", sender, u, current,
+                )
+                u = current
+            staleness = current - u
+            weight = float(self._staleness_fn(staleness)) * n
+            with trace.span("async/fold", sender=sender, version=u,
+                            staleness=staleness):
+                folded = self.aggregator.fold_async(sender - 1, flat, weight, u)
+            if not folded:
+                # duplicate/replayed (sender, version) leg: idempotent drop
+                self._window["dup"] += 1
+                self._totals["dup"] += 1
+                logging.info(
+                    "absorbed duplicate upload from worker %d (version %d "
+                    "already folded)", sender, u,
+                )
+                return
+            if staleness > 0:
+                self._window["stale"] += 1
+                self._totals["stale"] += 1
+                self._window["staleness_sum"] += staleness
+            emitted = False
+            record = None
+            ckpt_state = None
+            if self.aggregator.arrivals >= self.buffer_goal:
+                arrivals = self.aggregator.arrivals
+                with trace.span("async/emit", version=current,
+                                arrivals=arrivals):
+                    self.global_flat = self.aggregator.emit()
+                self.round_idx += 1
+                self._totals["emitted"] += 1
+                emitted = True
+                to_send = sorted(self._parked | {sender - 1})
+                self._parked.clear()
+                record = {
+                    "round": current,
+                    metricslib.ASYNC_ARRIVALS: arrivals,
+                    metricslib.ASYNC_STALE_FOLDS: self._window["stale"],
+                    metricslib.ASYNC_DUP_UPLOADS: self._window["dup"],
+                    metricslib.ASYNC_MEAN_STALENESS:
+                        self._window["staleness_sum"] / arrivals,
+                }
+                self._window = {"stale": 0, "dup": 0, "staleness_sum": 0}
+                ckpt_state = self._checkpoint_state()
+            elif staleness > 0:
+                # the worker trained an old version: hand it the current
+                # model right away — no barrier to wait for
+                to_send = [sender - 1]
+            else:
+                # trained the current version: re-dispatching it would
+                # reproduce the same update bit-for-bit — park until the
+                # next emission advances the version
+                self._parked.add(sender - 1)
+                to_send = []
+            done = emitted and self.round_idx >= self.round_num
+        # full-model disk I/O and downlink fan-outs run OUTSIDE the lock —
+        # they must not block the receive path (same discipline as the sync
+        # server's round close)
+        if ckpt_state is not None:
+            self._write_checkpoint(ckpt_state)
+        if record is not None:
+            if self._async_stats is not None:
+                self._async_stats.setdefault("rounds", []).append(record)
+            if self.on_round_done:
+                self.on_round_done(record["round"], self.global_flat)
+        if done:
+            self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                               [w + 1 for w in range(self.worker_num)],
+                               finished=True)
+            self.finish()
+            return
+        if to_send:
+            self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+                               [w + 1 for w in to_send],
+                               cohort=self._round_cohort())
+
+    def _round_timed_out(self, expected_round: int) -> None:  # pragma: no cover
+        raise AssertionError("async server mode arms no round timer")
+
+    def _downlink_failed(self, errors: dict[int, BaseException]) -> None:
+        """A failed dispatch leg must not strand its worker: the sync
+        server's round timeout re-covers a missed sync, but async mode has
+        no timer, and a worker that never receives a model never uploads
+        again. Re-park the failed ranks so the NEXT emission re-sends them
+        the then-current version. (With ``buffer_goal == worker_num`` the
+        next emission needs every worker, so a permanently unreachable rank
+        still wedges the run — exactly like the sync server without a
+        round_timeout; arm a retry_policy and a buffer_goal < worker_num
+        for liveness under lossy transports.)"""
+        for e in errors.values():
+            if getattr(e, "unretryable", False):
+                raise e
+        with self._round_lock:
+            self._parked.update(w - 1 for w in errors)
+        logging.warning(
+            "async downlink failed to ranks %s; re-parked for the next "
+            "emission's dispatch: %s",
+            sorted(errors),
+            "; ".join(f"{d}: {type(e).__name__}: {e}"
+                      for d, e in sorted(errors.items())),
+        )
+
+    def async_totals(self) -> dict:
+        return {
+            metricslib.ASYNC_MODELS_EMITTED: self._totals["emitted"],
+            metricslib.ASYNC_STALE_FOLDS: self._totals["stale"],
+            metricslib.ASYNC_DUP_UPLOADS: self._totals["dup"],
+        }
+
+    def restore_from_checkpoint(self, checkpointer=None,
+                                round_idx: int | None = None) -> int:
+        version = super().restore_from_checkpoint(checkpointer, round_idx)
+        with self._round_lock:
+            # in-flight dispatches died with the crashed process: the resume
+            # init re-broadcasts the restored version to EVERY worker, so
+            # nobody is parked
+            self._parked.clear()
+        return version
+
+
+class AsyncCompressedFedAvgServerManager(AsyncFedAvgServerManager,
+                                         CompressedFedAvgServerManager):
+    """Barrier-free server over the encoded-update uplink: EncodedUpdate
+    planes fold on arrival (staleness-weighted), bytes-on-wire accounting
+    unchanged."""
+
+    def _make_async_aggregator(self):
+        agg = AsyncCompressedFedAggregator(self.worker_num, self.codec)
+        agg.get_global = lambda: self.global_flat
+        return agg
+
+
+class AsyncRobustFedAvgServerManager(_RobustServerMixin,
+                                     AsyncFedAvgServerManager):
+    """Barrier-free server with the streaming clip+DP defense folded into
+    the arrival path (mean rule only; Robust/* records flush per emitted
+    version)."""
+
+    def __init__(self, *args, robust_config=None, robust_stats=None,
+                 **kwargs):
+        if robust_config is None:
+            raise ValueError(f"{type(self).__name__} needs a robust_config")
+        self._robust_config_pending = robust_config
+        super().__init__(*args, **kwargs)
+        self._init_robust(robust_config, robust_stats)
+
+    def _make_async_aggregator(self):
+        return AsyncRobustFedAggregator(
+            self.worker_num, self._robust_config_pending,
+            model_desc=self.model_desc,
+        )

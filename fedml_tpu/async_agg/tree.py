@@ -1,0 +1,578 @@
+"""Hierarchical aggregation tree over the message-passing backends.
+
+``algorithms/hierarchical.py`` reproduces the reference's two-level FL as
+nested SIM loops; this module generalizes the capability to the real wire
+path: clients upload to EDGE AGGREGATORS, every edge tier is itself a
+streaming accumulate-on-arrival tally (PR 5) over its own comm fabric, and
+each tier forwards ONE folded super-update upstream — so the root's fan-in
+is O(tiers), not O(clients), and no process ever holds more than O(model)
+aggregation state.
+
+The super-update is the RAW tally, not an average: the f64 accumulator
+(``sum_i n_i * x_i``) plus its weight sum, so the root's divide-at-close
+reproduces the flat server's weighted mean over all leaves. A 1-tier tree
+(one edge under the root, all clients under it) folds uploads in exactly
+the flat server's sequence and is therefore BIT-IDENTICAL to the flat
+server (tools/async_smoke.py, tier-1); wider trees regroup the f64
+additions per tier — the standard last-ULPs streaming tradeoff.
+
+Client-index assignment needs no routing tables: every leaf tier derives
+its children's cohort slots from the shared ``rnglib.sample_clients``
+schedule (round index + global leaf numbering), the same schedule the flat
+server uses — which is also what makes the 1-tier identity hold.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import threading
+from typing import Callable
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    FedAvgClientManager,
+    FedAvgDistAggregator,
+    FedAvgServerManager,
+    MyMessage,
+    init_template,
+)
+from fedml_tpu.comm.managers import DistributedManager
+from fedml_tpu.comm.message import Message, unpack_pytree
+from fedml_tpu.core import rng as rnglib
+from fedml_tpu.obs import trace
+
+
+class TreeMessage:
+    """Tier-routing message surface: an edge's folded super-update travels
+    upstream as a partial tally (f64 accumulator + weight sum), distinct
+    from a client's model upload."""
+
+    MSG_TYPE_T2S_SEND_PARTIAL = 4
+
+    MSG_ARG_KEY_WEIGHT_SUM = Message.MSG_ARG_KEY_WEIGHT_SUM
+    MSG_ARG_KEY_FOLD_COUNT = Message.MSG_ARG_KEY_FOLD_COUNT
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeTopology:
+    """Fan-in per tier, root downward; the last entry is clients per leaf
+    edge. ``(2, 4)`` = root over 2 edges x 4 clients each (8 leaves);
+    ``(1, N)`` is the 1-tier identity arm; ``(2, 2, 4)`` adds an inner
+    edge tier. A flat (edge-less) server is ``run_distributed_fedavg``."""
+
+    fan_ins: tuple[int, ...]
+
+    def __post_init__(self):
+        fan = tuple(int(f) for f in self.fan_ins)
+        object.__setattr__(self, "fan_ins", fan)
+        if len(fan) < 2:
+            raise ValueError(
+                f"a tree needs at least one edge tier (got fan_ins={fan}); "
+                "an edge-less server is run_distributed_fedavg"
+            )
+        if any(f < 1 for f in fan):
+            raise ValueError(f"every tier fan-in must be >= 1, got {fan}")
+
+    @property
+    def leaf_count(self) -> int:
+        return math.prod(self.fan_ins)
+
+    @property
+    def tier_count(self) -> int:
+        """Aggregation tiers between clients and root (edge tiers)."""
+        return len(self.fan_ins) - 1
+
+
+class TierAggregator(FedAvgDistAggregator):
+    """Streaming tally that also folds CHILD-TIER partials (f64 raw sums)
+    and exports its own tally as a partial instead of dividing — the
+    aggregation primitive every tree tier shares (the root folds partials
+    and inherits divide-at-close)."""
+
+    def add_partial_result(self, index: int, payload: np.ndarray,
+                           weight_sum: float) -> bool:
+        """Fold a child tier's super-update: the payload is that tier's f64
+        accumulator (already sample-weighted), so folding is a straight f64
+        add — no re-weighting, no precision loss."""
+        with self._lock:
+            flags = self.flag_client_model_uploaded_dict
+            if index not in flags:
+                return False
+            if flags[index]:
+                return all(flags.values())  # duplicate partial: first wins
+            part = np.ascontiguousarray(payload).view(np.float64)
+            if self._acc is None:
+                # first partial is COPIED, not added onto zeros: 0.0 + -0.0
+                # flips a sign bit, which would break the 1-tier
+                # bit-identity contract for exactly-(-0.0) coordinates
+                self._acc = np.array(part, np.float64)
+            else:
+                self._acc += part
+            self._wsum += float(weight_sum)
+            self.sample_num_dict[index] = float(weight_sum)
+            flags[index] = True
+            return all(flags.values())
+
+    def partial(self) -> tuple[np.ndarray, float, int]:
+        """Export the raw tally for the parent tier — (f64 accumulator as a
+        byte view, weight sum, folds) — and reset for the next round."""
+        with self._lock:
+            flags = self.flag_client_model_uploaded_dict
+            if self._acc is None:
+                raise self._empty_round_error()
+            out = np.ascontiguousarray(self._acc).view(np.uint8)
+            wsum = self._wsum
+            count = sum(1 for f in flags.values() if f)
+            self._acc = None
+            self._wsum = 0.0
+            for i in flags:
+                flags[i] = False
+            return out, wsum, count
+
+    def discard_window(self) -> int:
+        """Drop an unforwarded tally — the round moved on without this tier
+        (a slow child kept the window open past the root's timeout). Returns
+        the number of folds lost so the caller can account for them; mixing
+        them into the next round's partial would silently corrupt it."""
+        with self._lock:
+            flags = self.flag_client_model_uploaded_dict
+            lost = sum(1 for f in flags.values() if f)
+            self._acc = None
+            self._wsum = 0.0
+            self.sample_num_dict.clear()
+            for i in flags:
+                flags[i] = False
+            return lost
+
+
+class EdgeAggregatorManager(DistributedManager):
+    """One tree tier node: a streaming server to its children (model
+    uploads OR child partials, over its own down fabric) and a client to
+    its parent (one partial per round, over the up fabric). Observes BOTH
+    comms — message types are disjoint, so one handler table routes them.
+
+    ``leaf_base``/``leaf_total`` place this node's subtree in the global
+    leaf numbering; leaf tiers use it to assign their clients the same
+    cohort slots the flat server would."""
+
+    def __init__(self, up_comm, up_rank: int, down_comm, child_num: int,
+                 leaf_base: int, leaf_total: int, client_num_in_total: int,
+                 children_are_leaves: bool):
+        super().__init__(down_comm, rank=0, size=child_num + 1)
+        self.up_comm = up_comm
+        self.up_rank = up_rank
+        self.child_num = child_num
+        self.leaf_base = leaf_base
+        self.leaf_total = leaf_total
+        self.client_num_in_total = client_num_in_total
+        self.children_are_leaves = bool(children_are_leaves)
+        self.aggregator = TierAggregator(child_num)
+        self.stale_uploads = 0
+        self.duplicate_uploads = 0
+        self.discarded_folds = 0
+        self.stale_syncs = 0
+        self._round = 0
+        # per-child round of the last ACCEPTED contribution: the tally's
+        # first-wins flags reset when the tier forwards its partial, but the
+        # tier's round only advances on the next parent sync — a duplicated
+        # leg landing in that window would otherwise fold as a phantom
+        # first contribution of the NEXT window (and first-wins would then
+        # drop the child's genuine next-round upload)
+        self._last_child_round: dict[int, int] = {}
+        # the up fabric (parent syncs) and down fabric (child uploads) run
+        # handlers on DIFFERENT threads: round advance + window discard vs
+        # guard + fold must not interleave (same discipline as the flat
+        # server's _round_lock)
+        self._edge_lock = threading.Lock()
+        up_comm.add_observer(self)
+        self._up_thread: threading.Thread | None = None
+
+    # -- run loop: both fabrics ----------------------------------------------
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync_from_parent)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
+            self._on_sync_from_parent)
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self._on_child_model)
+        self.register_message_receive_handler(
+            TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, self._on_child_partial)
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self._up_thread = threading.Thread(
+            target=self.up_comm.handle_receive_message, daemon=True,
+            name=f"edge-up-r{self.up_rank}",
+        )
+        self._up_thread.start()
+        self.comm.handle_receive_message()  # down fabric, caller thread
+
+    def finish(self) -> None:
+        self.comm.stop_receive_message()
+        self.up_comm.stop_receive_message()
+
+    def _send_up(self, msg: Message) -> None:
+        policy = getattr(self.up_comm, "retry_policy", None)
+        if policy is None:
+            self.up_comm.send_message(msg)
+        else:
+            policy.run(lambda: self.up_comm.send_message(msg),
+                       dst=msg.get_receiver_id(), msg_type=msg.get_type())
+
+    # -- downlink: parent sync re-broadcast ----------------------------------
+
+    def _on_sync_from_parent(self, msg: Message) -> None:
+        if msg.get("finished"):
+            out = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+            out.add_params("finished", 1)
+            self.broadcast_message(out, list(range(1, self.child_num + 1)))
+            self.finish()
+            return
+        ridx = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if ridx is not None:
+            with self._edge_lock:
+                if int(ridx) < self._round:
+                    # a replayed/reordered old downlink leg (dup faults,
+                    # QoS re-delivery): adopting it would REGRESS the round,
+                    # discard the live window, and wedge the tier against
+                    # the root — drop the whole message (tree mode has no
+                    # checkpoint plane, so a backward round is never a
+                    # legitimate server restart)
+                    self.stale_syncs += 1
+                    logging.info(
+                        "edge tier (leaf_base=%d): dropping replayed "
+                        "round-%d sync (current=%d)",
+                        self.leaf_base, int(ridx), self._round,
+                    )
+                    return
+                if int(ridx) > self._round:
+                    # the parent moved on (root round-timeout excluded this
+                    # subtree mid-window): an unforwarded tally holds
+                    # OLD-round folds and must not leak into the new
+                    # window's partial
+                    lost = self.aggregator.discard_window()
+                    if lost:
+                        self.discarded_folds += lost
+                        logging.warning(
+                            "edge tier (leaf_base=%d): parent advanced to "
+                            "round %d with %d unforwarded round-%d fold(s) "
+                            "in the tally — discarding the stale window",
+                            self.leaf_base, int(ridx), lost, self._round,
+                        )
+                    self._round = int(ridx)
+        payload = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        out = Message(msg.get_type(), 0, 1)
+        # encode-once per tier: the children share ONE re-framed payload —
+        # the read-only view of the parent's frame, never a per-child copy
+        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
+        out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round)
+        version = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
+        if version is not None:
+            out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION, version)
+        desc = msg.get(MyMessage.MSG_ARG_KEY_MODEL_DESC)
+        if desc is not None:
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC, desc)
+        per_receiver = None
+        if self.children_are_leaves:
+            # the SAME cohort schedule as the flat server, indexed by this
+            # subtree's global leaf numbers — no routing tables on the wire
+            cohort = rnglib.sample_clients(
+                self._round, self.client_num_in_total, self.leaf_total
+            )
+            per_receiver = {
+                c: {MyMessage.MSG_ARG_KEY_CLIENT_INDEX:
+                    int(cohort[self.leaf_base + c - 1])}
+                for c in range(1, self.child_num + 1)
+            }
+        self.broadcast_message(out, list(range(1, self.child_num + 1)),
+                               per_receiver=per_receiver)
+
+    # -- uplink: fold children, forward one partial --------------------------
+
+    def _guard_round(self, msg: Message, kind: str) -> bool:
+        sender = msg.get_sender_id()
+        u = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        if u is not None and int(u) != self._round:
+            self.stale_uploads += 1
+            logging.info(
+                "edge tier (leaf_base=%d): discarding stale %s from child %d "
+                "(upload_round=%s, current=%d)",
+                self.leaf_base, kind, sender, u, self._round,
+            )
+            return False
+        if self._last_child_round.get(sender) == self._round:
+            # replayed leg for a round this child already contributed to —
+            # the tally may have been forwarded (flags reset) since, so the
+            # first-wins flags alone cannot catch it
+            self.duplicate_uploads += 1
+            logging.info(
+                "edge tier (leaf_base=%d): absorbed duplicate round-%d %s "
+                "from child %d", self.leaf_base, self._round, kind, sender,
+            )
+            return False
+        return True
+
+    def _on_child_model(self, msg: Message) -> None:
+        # guard + fold + record (+ forward) are one critical section
+        # against the up thread's round advance: a straggler that passed
+        # the guard for round r must fold into round r's tally or not at
+        # all, never into a freshly discarded next window
+        with self._edge_lock:
+            if not self._guard_round(msg, "model upload"):
+                return
+            sender = msg.get_sender_id()
+            flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+            n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+            with trace.span("tree/fold", kind="model", sender=sender,
+                            round=self._round):
+                done = self.aggregator.add_local_trained_result(
+                    sender - 1, flat, n)
+            self._last_child_round[sender] = self._round
+            if done:
+                self._forward_partial()
+
+    def _on_child_partial(self, msg: Message) -> None:
+        with self._edge_lock:
+            if not self._guard_round(msg, "partial"):
+                return
+            sender = msg.get_sender_id()
+            part = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+            wsum = float(msg.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
+            folds = msg.get(TreeMessage.MSG_ARG_KEY_FOLD_COUNT)
+            with trace.span("tree/fold", kind="partial", sender=sender,
+                            round=self._round,
+                            child_folds=int(folds) if folds is not None
+                            else -1):
+                done = self.aggregator.add_partial_result(
+                    sender - 1, part, wsum)
+            self._last_child_round[sender] = self._round
+            if done:
+                self._forward_partial()
+
+    def _forward_partial(self) -> None:
+        partial, wsum, count = self.aggregator.partial()
+        with trace.span("tree/forward", round=self._round, folds=count,
+                        bytes=int(partial.nbytes)):
+            out = Message(TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL,
+                          self.up_rank, 0)
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, partial)
+            out.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, float(wsum))
+            out.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, int(count))
+            out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round)
+            self._send_up(out)
+
+
+class TreeFedAvgServerManager(FedAvgServerManager):
+    """Tree root: the ordinary round protocol, but its direct workers are
+    edge tiers uploading partials — fold is a straight f64 add, close is
+    the inherited divide. Cohort assignment is delegated to the leaf tiers
+    (``_round_cohort`` is None: edges derive the same schedule locally)."""
+
+    def _round_cohort(self):
+        return None
+
+    def register_message_receive_handlers(self) -> None:
+        super().register_message_receive_handlers()
+        self.register_message_receive_handler(
+            TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, self._on_partial_from_tier)
+
+    def _make_aggregator(self):
+        return TierAggregator(self.worker_num)
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.buffered_aggregation:
+            raise ValueError(
+                "the tree root folds tier partials — there is no buffered "
+                "A/B arm (the flat server keeps the oracle)"
+            )
+        self.aggregator = self._make_aggregator()
+
+    def _on_partial_from_tier(self, msg: Message) -> None:
+        from fedml_tpu.comm.status import ClientStatus
+
+        sender = msg.get_sender_id()
+        part = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        wsum = float(msg.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
+        folds = msg.get(TreeMessage.MSG_ARG_KEY_FOLD_COUNT)
+        upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        with self._round_lock:
+            current = self.round_idx
+            if not self.aggregator.is_live(sender - 1):
+                if self.readmission:
+                    # an excluded tier resurfaced WITH a partial: provably
+                    # alive — queue readmission at the next round boundary,
+                    # exactly like the flat server's excluded-upload branch
+                    # (edges send no heartbeats, so the partial IS the
+                    # contact signal; on readmit the next sync advances the
+                    # tier's round and it discards its stale window)
+                    self.status.update(sender, ClientStatus.ONLINE)
+                    self._miss_counts.pop(sender - 1, None)
+                    if sender - 1 not in self._pending_readmit:
+                        logging.info(
+                            "excluded tier %d reappeared (partial for round "
+                            "%s); queueing readmission", sender, upload_round,
+                        )
+                    self._pending_readmit.add(sender - 1)
+                else:
+                    logging.info("ignoring partial from excluded tier %d",
+                                 sender)
+                return
+            if upload_round is not None and int(upload_round) != current:
+                self.stale_uploads += 1
+                logging.info(
+                    "discarding stale partial from tier %d (upload_round=%s, "
+                    "current=%d; Comm/StaleUploads=%d this run)",
+                    sender, upload_round, current, self.stale_uploads,
+                )
+                return
+            self.status.update(sender, ClientStatus.ONLINE)
+            with trace.span("tree/fold", kind="partial", sender=sender,
+                            round=current,
+                            child_folds=int(folds) if folds is not None
+                            else -1):
+                all_received = self.aggregator.add_partial_result(
+                    sender - 1, part, wsum
+                )
+            self._miss_counts.pop(sender - 1, None)
+            if not all_received and self.round_timeout is not None:
+                if self._round_timer is None:
+                    self._round_timer = threading.Timer(
+                        self.round_timeout, self._round_timed_out,
+                        args=(current,),
+                    )
+                    self._round_timer.daemon = True
+                    self._round_timer.start()
+        if all_received:
+            self._complete_round(current)
+
+
+# ---------------------------------------------------------------------------
+# Run harness: build the comm-fabric tree and drive the protocol
+# ---------------------------------------------------------------------------
+
+
+def _loopback_group_comm(path: tuple, world_size: int) -> Callable[[int], object]:
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+
+    fabric = LoopbackFabric(world_size)
+    return lambda r: LoopbackCommManager(fabric, r)
+
+
+def run_tree_fedavg(
+    trainer,
+    train_data,
+    topology: TreeTopology | tuple,
+    round_num: int,
+    batch_size: int,
+    seed: int = 0,
+    on_round_done=None,
+    init_overrides=None,
+    make_group_comm: Callable[[tuple, int], Callable[[int], object]] | None = None,
+    server_kwargs: dict | None = None,
+    join_timeout: float = 30.0,
+):
+    """End-to-end hierarchical FedAvg: root -> edge tiers -> leaf clients,
+    one comm group (fabric) per parent/children cell. ``make_group_comm
+    (group_path, world_size)`` returns that cell's ``rank -> comm`` factory
+    — the loopback default builds one in-process fabric per cell; any
+    backend with the BaseCommunicationManager contract slots in (the cells
+    are independent, so tiers can even mix transports). ``group_path`` is
+    ``()`` for the root cell and the tuple of child indices below it.
+    Returns the final global variables (the flat server's return shape)."""
+    topo = topology if isinstance(topology, TreeTopology) else TreeTopology(tuple(topology))
+    make_group = make_group_comm or _loopback_group_comm
+    fan = topo.fan_ins
+    leaf_total = topo.leaf_count
+    if leaf_total > train_data.num_clients:
+        raise ValueError(
+            f"tree topology {fan} has {leaf_total} leaves but the population "
+            f"only has {train_data.num_clients} clients"
+        )
+    template, flat, desc = init_template(trainer, train_data.arrays,
+                                         batch_size, seed,
+                                         init_overrides=init_overrides)
+    results: dict[str, np.ndarray] = {}
+
+    def _done(r, f):
+        results["final"] = f
+        if on_round_done is not None:
+            on_round_done(r, unpack_pytree(f, desc))
+
+    root_make = make_group((), fan[0] + 1)
+    server = TreeFedAvgServerManager(
+        root_make(0), fan[0], round_num, flat, desc,
+        client_num_in_total=train_data.num_clients,
+        on_round_done=_done, **(server_kwargs or {}),
+    )
+    managers: list = []
+
+    def build(path: tuple, up_make, up_rank: int, level: int,
+              leaf_base: int) -> int:
+        """Create the edge at ``path`` and its subtree; returns its leaf
+        count so sibling subtrees stack contiguously in the global leaf
+        numbering."""
+        child_num = fan[level]
+        down_make = make_group(path, child_num + 1)
+        leaves_here = 0
+        is_leaf_tier = level == len(fan) - 1
+        edge = EdgeAggregatorManager(
+            up_comm=up_make(up_rank), up_rank=up_rank, down_comm=down_make(0),
+            child_num=child_num, leaf_base=leaf_base, leaf_total=leaf_total,
+            client_num_in_total=train_data.num_clients,
+            children_are_leaves=is_leaf_tier,
+        )
+        managers.append(edge)
+        if is_leaf_tier:
+            for r in range(1, child_num + 1):
+                c = FedAvgClientManager(
+                    down_make(r), r, child_num + 1, trainer, train_data,
+                    batch_size, template,
+                )
+                # global leaf identity for the local-train rng chain: leaves
+                # in different cells share fabric-local ranks, but their key
+                # chains must not collide (and the 1-tier tree must chain
+                # exactly like the flat server's rank w)
+                c.rng_rank = leaf_base + r
+                managers.append(c)
+            leaves_here = child_num
+        else:
+            for i in range(child_num):
+                leaves_here += build(path + (i,), down_make, i + 1,
+                                     level + 1, leaf_base + leaves_here)
+        return leaves_here
+
+    leaf_base = 0
+    for i in range(fan[0]):
+        leaf_base += build((i,), root_make, i + 1, 1, leaf_base)
+
+    threads = [threading.Thread(target=m.run, daemon=True) for m in managers]
+    for t in threads:
+        t.start()
+    server.register_message_receive_handlers()
+    server.send_init_msg()
+    try:
+        server.comm.handle_receive_message()
+    except BaseException:
+        for m in managers:
+            try:
+                m.finish()
+            except Exception:  # noqa: BLE001 — best-effort unblock
+                pass
+        raise
+    for t in threads:
+        t.join(timeout=join_timeout)
+    return unpack_pytree(results["final"], desc)
+
+
+def run_tree_fedavg_loopback(trainer, train_data, topology, round_num,
+                             batch_size, **kwargs):
+    """Hierarchical FedAvg with every tier cell on an in-process loopback
+    fabric — the test/bench entry point."""
+    return run_tree_fedavg(trainer, train_data, topology, round_num,
+                           batch_size, **kwargs)

@@ -73,6 +73,15 @@ class Message:
     # byte vector of all encoded planes + the recursive structure descriptor
     MSG_ARG_KEY_ENCODED_UPDATE = "encoded_update"
     MSG_ARG_KEY_ENCODED_DESC = "encoded_desc"
+    # barrier-free server plane (fedml_tpu/async_agg): every async downlink
+    # stamps the global-model version it carries, clients echo it on their
+    # uploads, and the server staleness-weights the fold by the echoed
+    # version; tree partials carry the tier's weight sum (what the parent
+    # folds by) and fold count (observability: how many client updates the
+    # super-update represents)
+    MSG_ARG_KEY_MODEL_VERSION = "model_version"
+    MSG_ARG_KEY_WEIGHT_SUM = "weight_sum"
+    MSG_ARG_KEY_FOLD_COUNT = "fold_count"
 
     def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {

@@ -79,6 +79,36 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="broadcast send-pool width on the gRPC "
                              "transport; 0 = serial fan-out on the manager "
                              "thread (docs/PERFORMANCE.md server wire path)")
+    # barrier-free server plane (fedml_tpu/async_agg, docs/PERFORMANCE.md
+    # "Barrier-free aggregation"); message-passing backends only
+    parser.add_argument("--server_mode", type=str, default="sync",
+                        choices=["sync", "async", "tree"],
+                        help="sync = the round-barrier protocol; async = "
+                             "FedBuff-style buffered-async server (uploads "
+                             "fold on arrival staleness-weighted, a model "
+                             "version is emitted every --buffer_goal "
+                             "arrivals, --comm_round counts emitted "
+                             "versions); tree = hierarchical aggregation "
+                             "(clients -> edge tiers -> root, each tier a "
+                             "streaming accumulator forwarding one folded "
+                             "super-update)")
+    parser.add_argument("--buffer_goal", type=int, default=0,
+                        help="async mode: arrivals per emitted model "
+                             "version (0 = the worker count, which with "
+                             "the const staleness weight reproduces the "
+                             "sync path bit-for-bit)")
+    parser.add_argument("--staleness_weight", type=str, default="const",
+                        help="async mode: staleness decay family for "
+                             "folds of old-version uploads — const | "
+                             "poly:a | hinge:a,b (FedAsync family; "
+                             "s(0) == 1 always)")
+    parser.add_argument("--tree_fan_ins", type=str, default=None,
+                        help="tree mode: comma-separated fan-in per tier, "
+                             "root downward, last entry = clients per leaf "
+                             "edge (e.g. '4,16' = 4 edges x 16 clients); "
+                             "the leaf count must equal "
+                             "--client_num_per_round. Default: one edge "
+                             "over the whole cohort")
     # algorithm switch (fedall) + algorithm-specific knobs
     parser.add_argument("--algorithm", type=str, default="fedavg",
                         choices=["fedavg", "fedopt", "fedprox", "fednova", "fedgan",
@@ -346,13 +376,17 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         # the server's accountant flushes the round's Comm/* record into
         # comm_stats just before this callback fires (fedavg_distributed
         # _done), so bytes-on-wire land in the same metrics stream as
-        # Test/Acc; ditto the robust tally's Robust/* record
+        # Test/Acc; ditto the robust tally's Robust/* record and the async
+        # server's per-emission Async/* record
         for crec in comm_stats.get("rounds", []):
             if crec.get("round") == r:
                 rec.update({k: v for k, v in crec.items() if k != "round"})
         for rrec in robust_stats.get("rounds", []):
             if rrec.get("round") == r:
                 rec.update({k: v for k, v in rrec.items() if k != "round"})
+        for arec in async_stats.get("rounds", []):
+            if arec.get("round") == r:
+                rec.update({k: v for k, v in arec.items() if k != "round"})
         if ev is not None and (
             (r + 1) % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1
         ):
@@ -380,6 +414,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     codec_kwargs = {}
     comm_stats: dict = {}
     robust_stats: dict = {}
+    async_stats: dict = {}
     robust_kwargs: dict = {}
     if args.algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
@@ -448,21 +483,55 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         mobile_kwargs = mobile_runner_kwargs(ranks)
         logging.info("is_mobile=1: JSON nested-list wire format for ranks %s",
                      sorted(ranks))
-    final_variables = runners[args.backend](
-        trainer, ds.train,
-        worker_num=cfg.client_num_per_round,
-        round_num=cfg.comm_round,
-        batch_size=cfg.batch_size,
-        seed=cfg.seed,
-        on_round_done=on_round,
-        init_overrides=overrides,
-        **mobile_kwargs,
-        **codec_kwargs,
-        **robust_kwargs,
-        **ft_kwargs,
-    )
+    server_mode = getattr(args, "server_mode", "sync")
+    if server_mode == "tree":
+        # hierarchical aggregation: its process topology is a tree of comm
+        # cells, not the flat runners' single fan-out
+        from fedml_tpu.async_agg.tree import TreeTopology, run_tree_fedavg_loopback
+
+        fan_spec = getattr(args, "tree_fan_ins", None)
+        fan_ins = (tuple(int(f) for f in fan_spec.split(","))
+                   if fan_spec else (1, cfg.client_num_per_round))
+        topo = TreeTopology(fan_ins)
+        if topo.leaf_count != cfg.client_num_per_round:
+            raise ValueError(
+                f"--tree_fan_ins {fan_ins} has {topo.leaf_count} leaves but "
+                f"--client_num_per_round is {cfg.client_num_per_round}; the "
+                "leaves ARE the per-round cohort"
+            )
+        logging.info("tree mode: fan-ins %s (%d leaves, %d edge tiers)",
+                     fan_ins, topo.leaf_count, topo.tier_count)
+        final_variables = run_tree_fedavg_loopback(
+            trainer, ds.train, topo, cfg.comm_round, cfg.batch_size,
+            seed=cfg.seed, on_round_done=on_round, init_overrides=overrides,
+        )
+    else:
+        mode_kwargs = {}
+        if server_mode == "async":
+            mode_kwargs = {
+                "server_mode": "async",
+                "buffer_goal": getattr(args, "buffer_goal", 0) or None,
+                "staleness_weight": getattr(args, "staleness_weight", "const"),
+                "async_stats": async_stats,
+            }
+        final_variables = runners[args.backend](
+            trainer, ds.train,
+            worker_num=cfg.client_num_per_round,
+            round_num=cfg.comm_round,
+            batch_size=cfg.batch_size,
+            seed=cfg.seed,
+            on_round_done=on_round,
+            init_overrides=overrides,
+            **mobile_kwargs,
+            **codec_kwargs,
+            **robust_kwargs,
+            **ft_kwargs,
+            **mode_kwargs,
+        )
     if comm_stats.get("totals"):
         logging.info("bytes on wire: %s", comm_stats["totals"])
+    if async_stats.get("totals"):
+        logging.info("async server: %s", async_stats["totals"])
     if getattr(args, "save_params_to", None):
         from fedml_tpu.obs.checkpoint import save_params
 
@@ -499,6 +568,85 @@ def _run(args) -> list[dict]:
             "--fault_spec injects wire faults — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
         )
+    server_mode = getattr(args, "server_mode", "sync")
+    if server_mode != "sync":
+        if args.backend == "sim":
+            raise NotImplementedError(
+                f"--server_mode {server_mode} selects a message-passing "
+                "server execution mode — there is no server process on "
+                "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
+            )
+        if getattr(args, "is_mobile", 0):
+            raise NotImplementedError(
+                f"--server_mode {server_mode} and --is_mobile both redefine "
+                "the server protocol; pick one"
+            )
+    if server_mode != "async":
+        misapplied = [
+            flag for flag, val in [
+                ("--buffer_goal", getattr(args, "buffer_goal", 0)),
+                ("--staleness_weight",
+                 getattr(args, "staleness_weight", "const") != "const"),
+            ] if val
+        ]
+        if misapplied:
+            # same loud-rejection convention as the unwired tree flags
+            # below: silently dropping these would fake a staleness
+            # experiment as a plain sync/tree run
+            raise NotImplementedError(
+                f"not valid with --server_mode {server_mode}: "
+                f"{', '.join(misapplied)} (buffered-async server knobs) — "
+                "pick --server_mode async"
+            )
+    if server_mode != "tree" and getattr(args, "tree_fan_ins", None):
+        raise NotImplementedError(
+            "--tree_fan_ins shapes the hierarchical tier topology and is "
+            f"ignored under --server_mode {server_mode} — pick "
+            "--server_mode tree"
+        )
+    if server_mode == "tree":
+        if args.backend != "loopback":
+            raise NotImplementedError(
+                "--server_mode tree runs each tier cell on its own comm "
+                "fabric; this entry wires the loopback cells — drive other "
+                "transports through "
+                "fedml_tpu.async_agg.tree.run_tree_fedavg(make_group_comm=...)"
+            )
+        if getattr(args, "compressor", "none") != "none":
+            raise NotImplementedError(
+                "--server_mode tree forwards raw f64 partials between "
+                "tiers; the encoded-update uplink composes with "
+                "--server_mode sync|async only"
+            )
+        if args.algorithm == "fedavg_robust":
+            raise NotImplementedError(
+                "--server_mode tree has no per-tier defense yet; "
+                "--algorithm fedavg_robust composes with "
+                "--server_mode sync|async"
+            )
+        unwired = [
+            flag for flag, val in [
+                ("--fault_spec", getattr(args, "fault_spec", None)),
+                ("--send_retries", getattr(args, "send_retries", 0)),
+                ("--heartbeat_interval",
+                 getattr(args, "heartbeat_interval", 0.0)),
+                ("--checkpoint_dir", getattr(args, "checkpoint_dir", None)),
+                ("--resume", getattr(args, "resume", 0)),
+            ] if val
+        ]
+        if unwired:
+            # these flags are consumed by the flat runner the tree branch
+            # bypasses — ignoring them silently would fake a robustness or
+            # recovery experiment (same loud-rejection convention as the
+            # sim-backend guards above)
+            raise NotImplementedError(
+                f"{', '.join(unwired)} not wired into --server_mode tree "
+                "yet: the tree branch drives its own per-cell harness "
+                "(async_agg.tree.run_tree_fedavg), which does not take the "
+                "fault/retry/heartbeat/checkpoint planes — use "
+                "--server_mode sync|async, or drive the harness API "
+                "directly"
+            )
     if (getattr(args, "send_retries", 0)
             or getattr(args, "heartbeat_interval", 0.0)) and args.backend == "sim":
         raise NotImplementedError(

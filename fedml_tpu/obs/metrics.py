@@ -41,6 +41,28 @@ _RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
 # run_distributed_fedavg when a RetryPolicy is armed.
 COMM_RETRY_COUNT = "Comm/RetryCount"
 
+# Stale uploads at the synchronous server (docs/PERFORMANCE.md
+# "Barrier-free aggregation"): a straggler's model from an already-closed
+# round that the sync round protocol must discard (the async server folds
+# these with a staleness weight instead). Emitted into comm_stats totals by
+# run_distributed_fedavg — the observability baseline async staleness
+# weighting builds on.
+COMM_STALE_UPLOADS = "Comm/StaleUploads"
+
+# Async / barrier-free server keys (docs/PERFORMANCE.md "Barrier-free
+# aggregation"): per-emission-window fold counts from the buffered-async
+# tally (async_agg.AsyncFedAggregator). Arrivals is the number of uploads
+# folded into the emitted model (== buffer_goal), StaleFolds how many of
+# them trained an older model version (folded with the staleness weight,
+# never dropped), DuplicateUploads how many replayed (sender, version)
+# pairs the idempotence guard absorbed, MeanStaleness the mean version lag
+# over the window's folds. ModelsEmitted rides the run totals.
+ASYNC_ARRIVALS = "Async/Arrivals"
+ASYNC_STALE_FOLDS = "Async/StaleFolds"
+ASYNC_DUP_UPLOADS = "Async/DuplicateUploads"
+ASYNC_MEAN_STALENESS = "Async/MeanStaleness"
+ASYNC_MODELS_EMITTED = "Async/ModelsEmitted"
+
 # Robust-aggregation defense keys (docs/ROBUSTNESS.md): per-round mean
 # pre-clip update norm, fraction of the cohort whose delta got clipped, and
 # how many client updates the combine rule discarded (krum keeps one,

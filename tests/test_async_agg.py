@@ -1,0 +1,697 @@
+"""Barrier-free server-plane tests (docs/PERFORMANCE.md "Barrier-free
+aggregation"): staleness-weight families vs hand oracles, the versioned
+fold idempotence guard, deterministic async protocol drive (park /
+dispatch / emission), duplicate/late-upload behavior under the wire fault
+kinds, async crash-resume through the server checkpointer, the
+hierarchical tier aggregator, and the tier-1 async smoke. The 10^4-client
+soak (acceptance: >= 10^4 simulated uploads per emitted-model window at
+O(model) host memory) is marked slow."""
+
+import tempfile
+import shutil
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg_distributed import (
+    EmptyRoundError,
+    FedAvgDistAggregator,
+    MyMessage,
+    run_distributed_fedavg_loopback,
+)
+from fedml_tpu.async_agg.server import (
+    AsyncFedAggregator,
+    AsyncFedAvgServerManager,
+)
+from fedml_tpu.async_agg.staleness import make_staleness_fn
+from fedml_tpu.async_agg.tree import (
+    TierAggregator,
+    TreeTopology,
+    run_tree_fedavg_loopback,
+)
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+from fedml_tpu.comm.message import Message, pack_pytree
+from fedml_tpu.obs import metrics as metricslib
+from fedml_tpu.sim.async_oracle import AsyncUpload, replay_async_schedule
+
+
+def _lr_fixture(workers=4, samples=24):
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=samples,
+                              num_classes=4, seed=11)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+    return trainer, train
+
+
+# ---------------------------------------------------------------------------
+# staleness-weight families
+# ---------------------------------------------------------------------------
+
+
+def test_staleness_families_match_hand_oracle():
+    s = make_staleness_fn("const")
+    assert [s(d) for d in (0, 1, 7)] == [1.0, 1.0, 1.0]
+    s = make_staleness_fn("poly:0.5")
+    for d in (0, 1, 3, 8):
+        assert s(d) == (1.0 + d) ** -0.5
+    s = make_staleness_fn("hinge:0.25,2")
+    assert s(0) == 1.0 and s(2) == 1.0  # inside the hinge
+    assert s(4) == 1.0 / (0.25 * (4 - 2) + 1.0)
+    assert s(10) == 1.0 / (0.25 * 8 + 1.0)
+
+
+def test_staleness_spec_errors_name_the_family_set():
+    with pytest.raises(ValueError, match="unknown staleness family"):
+        make_staleness_fn("exp:1")
+    with pytest.raises(ValueError, match="malformed staleness args"):
+        make_staleness_fn("poly:abc")
+    with pytest.raises(ValueError, match="got 2 arg"):
+        make_staleness_fn("poly:1,2")
+    with pytest.raises(ValueError, match=">= 0"):
+        make_staleness_fn("poly:-1")
+
+
+@pytest.mark.parametrize("spec", ["const", "poly:1.0", "hinge:0.5,1"])
+def test_async_fold_weight_matches_oracle(spec):
+    """The aggregator's staleness-weighted fold sequence must equal the
+    pure-numpy replay bit-for-bit for every decay family — the exactness
+    arm (fedml_tpu.sim.async_oracle)."""
+    rng = np.random.RandomState(3)
+    s = make_staleness_fn(spec)
+    # versions 0,0,1,1,2,2 against a server at version 2: staleness 2,2,1,1,0,0
+    ups = [AsyncUpload(rng.randn(32).astype(np.float32), 2.0 + i, i // 2)
+           for i in range(6)]
+    agg = AsyncFedAggregator(6)
+    for i, up in enumerate(ups):
+        w = float(s(2 - up.version)) * up.n
+        assert agg.fold_async(i, up.x.view(np.uint8), w, up.version)
+    got = agg.emit().view(np.float32)
+    models, records = replay_async_schedule(ups, buffer_goal=6, staleness=s,
+                                            start_version=2)
+    np.testing.assert_array_equal(got, models[0])
+    assert records[0]["stale_folds"] == 4
+    # the weights themselves are hand-checkable
+    for w, up in zip(records[0]["fold_weights"], ups):
+        assert w == float(s(2 - up.version)) * up.n
+
+
+def test_fold_async_duplicate_version_is_idempotent():
+    agg = AsyncFedAggregator(2)
+    x = np.ones(8, np.float32)
+    assert agg.fold_async(0, x.view(np.uint8), 1.0, 0)
+    assert agg.arrivals == 1
+    # replayed leg: same (sender, version) — dropped, counter untouched
+    assert not agg.fold_async(0, x.view(np.uint8), 1.0, 0)
+    assert agg.arrivals == 1
+    # an older version than already folded is also a replay
+    assert agg.fold_async(0, 2 * x.view(np.uint8), 1.0, 3)
+    assert not agg.fold_async(0, x.view(np.uint8), 1.0, 1)
+    assert agg.arrivals == 2
+
+
+# ---------------------------------------------------------------------------
+# deterministic protocol drive (no client threads)
+# ---------------------------------------------------------------------------
+
+
+def _make_async_server(workers=3, rounds=4, buffer_goal=2, **kw):
+    flat, desc = pack_pytree({"w": np.zeros(8, np.float32)})
+    fabric = LoopbackFabric(workers + 1)
+    emitted = []
+    stats: dict = {}
+    server = AsyncFedAvgServerManager(
+        LoopbackCommManager(fabric, 0), workers, rounds, flat, desc,
+        on_round_done=lambda r, f: emitted.append(
+            (r, np.asarray(f).view(np.float32).copy())
+        ),
+        buffer_goal=buffer_goal, async_stats=stats, **kw,
+    )
+    return server, fabric, emitted, stats
+
+
+def _upload(sender, version, x, n=2.0):
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, sender, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                   np.asarray(x, np.float32).view(np.uint8))
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, int(version))
+    return msg
+
+
+def test_async_protocol_park_dispatch_emit():
+    """Drive the handler directly: fresh uploads park, the Kth arrival
+    emits + broadcasts to the parked set, stale uploads fold weighted and
+    get the current model back immediately."""
+    server, fabric, emitted, stats = _make_async_server(
+        workers=3, rounds=4, buffer_goal=2, staleness_weight="poly:1.0",
+    )
+    xs = [np.full(8, float(i + 1), np.float32) for i in range(6)]
+    server._on_model_from_client(_upload(1, 0, xs[0]))
+    # fresh upload below the buffer goal: parked, no downlink yet
+    assert fabric.queues[1].qsize() == 0
+    assert server._parked == {0}
+    server._on_model_from_client(_upload(2, 0, xs[1]))
+    # emission: version bumped, parked + triggering workers dispatched
+    assert server.round_idx == 1
+    assert fabric.queues[1].qsize() == 1 and fabric.queues[2].qsize() == 1
+    assert fabric.queues[3].qsize() == 0  # never uploaded, never dispatched
+    assert server._parked == set()
+    # worker 3 trained version 0, arrives late: folds at weight s(1) and is
+    # handed the current model immediately — no barrier to wait for
+    server._on_model_from_client(_upload(3, 0, xs[2]))
+    assert fabric.queues[3].qsize() == 1
+    assert server._parked == set()
+    server._on_model_from_client(_upload(1, 1, xs[3]))
+    assert server.round_idx == 2
+    rec0, rec1 = stats["rounds"][0], stats["rounds"][1]
+    assert rec0[metricslib.ASYNC_STALE_FOLDS] == 0
+    assert rec1[metricslib.ASYNC_STALE_FOLDS] == 1
+    assert rec1[metricslib.ASYNC_MEAN_STALENESS] == 0.5
+    # bitwise: the emitted models equal the oracle replay of this schedule
+    ups = [AsyncUpload(xs[0], 2.0, 0), AsyncUpload(xs[1], 2.0, 0),
+           AsyncUpload(xs[2], 2.0, 0), AsyncUpload(xs[3], 2.0, 1)]
+    models, _ = replay_async_schedule(ups, buffer_goal=2,
+                                      staleness="poly:1.0")
+    assert len(emitted) == 2
+    for (_, got), want in zip(emitted, models):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_async_upload_version_echo_takes_precedence():
+    """The client echoes the downlink's explicit version stamp; the server
+    folds by the echo (round index stays the compatible fallback)."""
+    server, fabric, emitted, stats = _make_async_server(
+        workers=2, rounds=3, buffer_goal=1, staleness_weight="poly:1.0",
+    )
+    server.round_idx = 2
+    msg = _upload(1, 2, np.ones(8, np.float32))
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_VERSION, 0)  # echo says stale
+    server._on_model_from_client(msg)
+    assert stats["rounds"][0][metricslib.ASYNC_STALE_FOLDS] == 1
+    assert stats["rounds"][0][metricslib.ASYNC_MEAN_STALENESS] == 2.0
+
+
+def test_async_failed_dispatch_reparks_worker():
+    """A failed emission-dispatch leg must not strand its worker forever
+    (async has no round timeout to re-cover a missed sync): the rank is
+    re-parked and re-dispatched at the next emission."""
+    server, fabric, emitted, stats = _make_async_server(
+        workers=3, rounds=4, buffer_goal=2,
+    )
+    server._downlink_failed({3: RuntimeError("transient leg")})
+    assert server._parked == {2}
+    x = np.ones(8, np.float32)
+    server._on_model_from_client(_upload(1, 0, x))
+    server._on_model_from_client(_upload(2, 0, x))  # emission
+    assert server._parked == set()
+    assert fabric.queues[3].qsize() == 1  # the re-parked rank got the model
+    # injected crashes still re-raise — they are process death, not a leg
+    boom = RuntimeError("crash")
+    boom.unretryable = True
+    with pytest.raises(RuntimeError, match="crash"):
+        server._downlink_failed({1: boom})
+
+
+def test_async_duplicate_upload_absorbed_and_counted():
+    server, fabric, emitted, stats = _make_async_server()
+    x = np.ones(8, np.float32)
+    server._on_model_from_client(_upload(1, 0, x))
+    server._on_model_from_client(_upload(1, 0, x))  # replayed dup leg
+    assert server.aggregator.arrivals == 1
+    assert server._totals["dup"] == 1
+    server._on_model_from_client(_upload(2, 0, x))
+    assert emitted and stats["rounds"][0][metricslib.ASYNC_DUP_UPLOADS] == 1
+    assert server.async_totals()[metricslib.ASYNC_DUP_UPLOADS] == 1
+
+
+def test_async_server_validation():
+    flat, desc = pack_pytree({"w": np.zeros(4, np.float32)})
+    fabric = LoopbackFabric(3)
+    make = lambda **kw: AsyncFedAvgServerManager(  # noqa: E731
+        LoopbackCommManager(fabric, 0), 2, 3, flat, desc, **kw)
+    with pytest.raises(ValueError, match="deadlock"):
+        make(buffer_goal=3)
+    with pytest.raises(ValueError, match="round_timeout"):
+        make(round_timeout=1.0)
+    with pytest.raises(ValueError, match="buffered"):
+        make(buffered_aggregation=True)
+    with pytest.raises(ValueError, match="unknown staleness"):
+        make(staleness_weight="nope")
+
+
+def test_run_distributed_rejects_bad_async_combinations():
+    trainer, train = _lr_fixture(workers=2)
+    with pytest.raises(ValueError, match="unknown server_mode"):
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=2, round_num=1, batch_size=8,
+            server_mode="tree",
+        )
+    with pytest.raises(ValueError, match="round_timeout"):
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=2, round_num=1, batch_size=8,
+            server_mode="async", round_timeout=5.0,
+        )
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+    with pytest.raises(NotImplementedError, match="mean"):
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=2, round_num=1, batch_size=8,
+            server_mode="async",
+            robust_config=RobustDistConfig(rule="median"),
+        )
+
+
+# ---------------------------------------------------------------------------
+# wire fault kinds: dup / delay (comm/faults.py)
+# ---------------------------------------------------------------------------
+
+
+def test_async_dup_fault_end_to_end():
+    """A transport that duplicates every send (PR 6 ``dup``): the replayed
+    (sender, version) uplink legs are absorbed by the idempotence guard —
+    the run completes with exactly round_num emitted models."""
+    trainer, train = _lr_fixture()
+    stats: dict = {}
+    final = run_distributed_fedavg_loopback(
+        trainer, train, worker_num=4, round_num=2, batch_size=8,
+        server_mode="async", fault_specs="2:dup=1.0", async_stats=stats,
+    )
+    import jax
+
+    assert stats["totals"][metricslib.ASYNC_MODELS_EMITTED] == 2
+    assert stats["totals"][metricslib.ASYNC_DUP_UPLOADS] >= 1
+    for leaf in jax.tree.leaves(final):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_async_delay_fault_still_fills_every_window():
+    """A delayed uplink (PR 6 ``delay``) must never wedge the barrier-free
+    protocol: late uploads fold (staleness-weighted when the version moved
+    on) and every emission window still fills — the run emits exactly
+    round_num models. Whether a given late upload IS stale depends on
+    thread scheduling, so the stale-fold arithmetic itself is pinned by the
+    deterministic protocol-drive test above, not by this race."""
+    trainer, train = _lr_fixture()
+    stats: dict = {}
+    run_distributed_fedavg_loopback(
+        trainer, train, worker_num=4, round_num=6, batch_size=8,
+        server_mode="async", buffer_goal=2, staleness_weight="poly:0.5",
+        fault_specs="2:delay=0.4@1.0", async_stats=stats,
+    )
+    assert stats["totals"][metricslib.ASYNC_MODELS_EMITTED] == 6
+    assert all(r[metricslib.ASYNC_ARRIVALS] == 2 for r in stats["rounds"])
+
+
+def test_sync_stale_upload_counted_not_silent(caplog):
+    """Satellite: the sync server now counts + logs the (sender,
+    upload_round, current) triple instead of discarding silently."""
+    import logging
+
+    flat, desc = pack_pytree({"w": np.zeros(8, np.float32)})
+    fabric = LoopbackFabric(3)
+    from fedml_tpu.algorithms.fedavg_distributed import FedAvgServerManager
+
+    server = FedAvgServerManager(LoopbackCommManager(fabric, 0), 2, 3,
+                                 flat, desc)
+    server.round_idx = 4
+    with caplog.at_level(logging.INFO):
+        server._on_model_from_client(_upload(2, 3, np.ones(8, np.float32)))
+    assert server.stale_uploads == 1
+    assert server.aggregator.received_workers() == []
+    joined = " ".join(r.getMessage() for r in caplog.records)
+    assert "worker 2" in joined and "upload_round=3" in joined
+    assert "current=4" in joined
+
+
+def test_sync_stale_uploads_land_in_comm_stats():
+    """The counter rides comm_stats totals whenever the caller passes the
+    dict — zero stale uploads is an explicit 0, not a missing key."""
+    trainer, train = _lr_fixture(workers=2)
+    comm_stats: dict = {}
+    run_distributed_fedavg_loopback(
+        trainer, train, worker_num=2, round_num=1, batch_size=8,
+        comm_stats=comm_stats,
+    )
+    assert comm_stats["totals"][metricslib.COMM_STALE_UPLOADS] == 0
+
+
+# ---------------------------------------------------------------------------
+# crash-resume: the arrival window survives a restart
+# ---------------------------------------------------------------------------
+
+
+def test_async_snapshot_restores_arrival_counter_and_guard():
+    rng = np.random.RandomState(0)
+    xs = [rng.randn(16).astype(np.float32) for _ in range(5)]
+    ref = AsyncFedAggregator(5)
+    live = AsyncFedAggregator(5)
+    for i in range(3):
+        ref.fold_async(i, xs[i].view(np.uint8), 2.0 + i, i % 2)
+        live.fold_async(i, xs[i].view(np.uint8), 2.0 + i, i % 2)
+    # checkpoint the mid-window state through the PR 8 server snapshotter
+    ckpt_dir = tempfile.mkdtemp(prefix="async_ckpt_")
+    try:
+        from fedml_tpu.obs.checkpoint import RoundCheckpointer
+
+        ckptr = RoundCheckpointer(ckpt_dir)
+        ckptr.save_server(7, {"aggregator": live.snapshot_state()})
+        restored = AsyncFedAggregator(5)
+        restored.restore_state(ckptr.restore_server(7)["aggregator"])
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    assert restored.arrivals == 3
+    assert restored.last_folded == {0: 0, 1: 1, 2: 0}
+    # the restored window continues bit-identically to the uninterrupted one
+    for i in (3, 4):
+        ref.fold_async(i, xs[i].view(np.uint8), 1.5, 2)
+        restored.fold_async(i, xs[i].view(np.uint8), 1.5, 2)
+    np.testing.assert_array_equal(ref.emit(), restored.emit())
+    assert restored.arrivals == 0
+
+
+def test_async_checkpoint_resume_completed_run():
+    """A finished async run restored with resume=True returns the
+    checkpointed model without re-running (the flat path's contract)."""
+    import jax
+
+    trainer, train = _lr_fixture()
+    ckpt_dir = tempfile.mkdtemp(prefix="async_resume_")
+    try:
+        final = run_distributed_fedavg_loopback(
+            trainer, train, worker_num=4, round_num=2, batch_size=8,
+            server_mode="async", checkpoint_dir=ckpt_dir, checkpoint_every=1,
+        )
+        resumed = run_distributed_fedavg_loopback(
+            trainer, train, worker_num=4, round_num=2, batch_size=8,
+            server_mode="async", checkpoint_dir=ckpt_dir, resume=True,
+        )
+        for a, b in zip(jax.tree.leaves(final), jax.tree.leaves(resumed)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    finally:
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# hierarchical tree
+# ---------------------------------------------------------------------------
+
+
+def test_tree_topology_validation():
+    with pytest.raises(ValueError, match="edge tier"):
+        TreeTopology((4,))
+    with pytest.raises(ValueError, match=">= 1"):
+        TreeTopology((2, 0))
+    topo = TreeTopology((2, 3, 4))
+    assert topo.leaf_count == 24 and topo.tier_count == 2
+
+
+def test_tier_aggregator_partial_roundtrip():
+    """Leaf tier folds models, exports the raw tally; the parent folds two
+    partials and closes to the flat weighted mean — all hand-checkable."""
+    rng = np.random.RandomState(1)
+    xs = [rng.randn(8).astype(np.float32) for _ in range(4)]
+    ns = [2.0, 3.0, 4.0, 5.0]
+    edges = [TierAggregator(2), TierAggregator(2)]
+    for (e, child), x, n in zip([(0, 0), (0, 1), (1, 0), (1, 1)], xs, ns):
+        edges[e].add_local_trained_result(child, x.view(np.uint8), n)
+    root = TierAggregator(2)
+    for i, e in enumerate(edges):
+        part, wsum, count = e.partial()
+        assert count == 2
+        assert not root.add_partial_result(i, part, wsum) or i == 1
+    got = root.aggregate().view(np.float32)
+    acc = np.zeros(8, np.float64)
+    for x, n in zip(xs, ns):
+        acc += np.multiply(x, n, dtype=np.float64)
+    want = (acc / sum(ns)).astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+    # empty-tier export is a protocol bug, reported loudly
+    with pytest.raises(EmptyRoundError):
+        TierAggregator(2).partial()
+
+
+def test_tier_partial_preserves_negative_zero():
+    """The first partial is copied, not added onto zeros — 0.0 + (-0.0)
+    would flip the sign bit and break the 1-tier identity."""
+    edge = TierAggregator(1)
+    x = np.array([-0.0, 1.0], np.float32)
+    edge.add_local_trained_result(0, x.view(np.uint8), 1.0)
+    part, wsum, _ = edge.partial()
+    root = TierAggregator(1)
+    root.add_partial_result(0, part, wsum)
+    got = root.aggregate().view(np.float32)
+    flat = FedAvgDistAggregator(1)
+    flat.add_local_trained_result(0, x.view(np.uint8), 1.0)
+    np.testing.assert_array_equal(got.view(np.uint8),
+                                  flat.aggregate())
+
+
+def test_two_tier_tree_matches_flat_closely():
+    """A (2, 2) tree regroups the f64 folds per tier — allclose to the
+    flat server (bitwise identity is the 1-tier contract, held by the
+    smoke)."""
+    import jax
+
+    trainer, train = _lr_fixture()
+    tree_final = run_tree_fedavg_loopback(trainer, train, (2, 2), 2, 8)
+    flat_final = run_distributed_fedavg_loopback(
+        trainer, train, worker_num=4, round_num=2, batch_size=8)
+    for a, b in zip(jax.tree.leaves(tree_final), jax.tree.leaves(flat_final)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_edge_absorbs_duplicate_after_partial_forward():
+    """A replayed child leg landing AFTER the tier forwarded its partial
+    but BEFORE the next parent sync must not fold as a phantom first
+    contribution of the next window (the tally's first-wins flags reset at
+    forward; the per-child round guard has to catch it)."""
+    from fedml_tpu.async_agg.tree import EdgeAggregatorManager
+
+    up_fabric, down_fabric = LoopbackFabric(2), LoopbackFabric(3)
+    edge = EdgeAggregatorManager(
+        up_comm=LoopbackCommManager(up_fabric, 1), up_rank=1,
+        down_comm=LoopbackCommManager(down_fabric, 0), child_num=2,
+        leaf_base=0, leaf_total=2, client_num_in_total=2,
+        children_are_leaves=True,
+    )
+    edge.register_message_receive_handlers()
+    x = np.ones(8, np.float32)
+    edge._on_child_model(_upload(1, 0, x, n=2.0))
+    edge._on_child_model(_upload(2, 0, x, n=3.0))
+    assert up_fabric.queues[0].qsize() == 1  # round-0 partial forwarded
+    # replayed round-0 leg from child 1, delivered post-forward: absorbed
+    edge._on_child_model(_upload(1, 0, x, n=2.0))
+    assert edge.duplicate_uploads == 1
+    assert up_fabric.queues[0].qsize() == 1
+    assert edge.aggregator.received_workers() == []
+    # the next round's genuine contributions still fold and forward
+    sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+    sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, x.view(np.uint8))
+    sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 1)
+    edge._on_sync_from_parent(sync)
+    edge._on_child_model(_upload(1, 1, x, n=2.0))
+    edge._on_child_model(_upload(2, 1, x, n=3.0))
+    assert up_fabric.queues[0].qsize() == 2
+    assert edge.duplicate_uploads == 1 and edge.stale_uploads == 0
+
+
+def test_edge_discards_stale_window_when_parent_advances():
+    """If the root times out a round while this tier's window is only
+    partially filled (one slow child), the next parent sync advances the
+    round — the unforwarded tally holds OLD-round folds and must be
+    discarded, not mixed into the new window's partial."""
+    from fedml_tpu.async_agg.tree import EdgeAggregatorManager
+
+    up_fabric, down_fabric = LoopbackFabric(2), LoopbackFabric(3)
+    edge = EdgeAggregatorManager(
+        up_comm=LoopbackCommManager(up_fabric, 1), up_rank=1,
+        down_comm=LoopbackCommManager(down_fabric, 0), child_num=2,
+        leaf_base=0, leaf_total=2, client_num_in_total=2,
+        children_are_leaves=True,
+    )
+    edge.register_message_receive_handlers()
+    x = np.ones(8, np.float32)
+    # round 0: only child 1 arrives — window stays open, nothing forwarded
+    edge._on_child_model(_upload(1, 0, x, n=7.0))
+    assert up_fabric.queues[0].qsize() == 0
+    # root timed out round 0; its sync advances this tier to round 1
+    sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+    sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, x.view(np.uint8))
+    sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 1)
+    edge._on_sync_from_parent(sync)
+    assert edge.discarded_folds == 1
+    assert edge.aggregator.received_workers() == []
+    # the slow child's round-0 upload lands late: stale, not folded
+    edge._on_child_model(_upload(2, 0, x, n=5.0))
+    assert edge.stale_uploads == 1
+    # a replayed round-0 sync (dup fault / QoS re-delivery) must NOT
+    # regress the round, discard the live window, or reach the children
+    edge._on_child_model(_upload(1, 1, x, n=2.0))
+    downstream = down_fabric.queues[1].qsize()
+    stale_sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
+    stale_sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                          x.view(np.uint8))
+    stale_sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+    edge._on_sync_from_parent(stale_sync)
+    assert edge.stale_syncs == 1
+    assert edge._round == 1
+    assert edge.aggregator.received_workers() == [0]  # window intact
+    assert down_fabric.queues[1].qsize() == downstream  # not re-broadcast
+    # round 1 fills normally and the forwarded partial is round-1 ONLY
+    edge._on_child_model(_upload(2, 1, x, n=3.0))
+    assert up_fabric.queues[0].qsize() == 1
+    part = Message.from_bytes(up_fabric.queues[0].get_nowait())
+    assert part.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) == 1
+    assert part.get(Message.MSG_ARG_KEY_WEIGHT_SUM) == 5.0  # not 7+2+3
+
+
+def test_excluded_tier_requeues_readmission_via_partial():
+    """Edges send no heartbeats, so a partial from an excluded tier IS the
+    contact signal: with readmission on it queues the tier's return at the
+    next round boundary (mirroring the flat server's excluded-upload
+    branch); with readmission off it stays ignored. Either way the stale
+    partial itself must not fold."""
+    from fedml_tpu.async_agg.tree import TreeFedAvgServerManager, TreeMessage
+
+    trainer, train = _lr_fixture(workers=2)
+    from fedml_tpu.algorithms.fedavg_distributed import init_template
+
+    _, flat, desc = init_template(trainer, train.arrays, 8)
+    for readmission in (True, False):
+        fabric = LoopbackFabric(3)
+        root = TreeFedAvgServerManager(
+            LoopbackCommManager(fabric, 0), 2, 2, flat, desc,
+            readmission=readmission,
+        )
+        root.aggregator.exclude_worker(1)
+        part = Message(TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, 2, 0)
+        acc = np.multiply(flat.view(np.float32), 3.0, dtype=np.float64)
+        part.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                        acc.view(np.uint8))
+        part.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, 3.0)
+        part.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, 2)
+        part.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+        root._on_partial_from_tier(part)
+        assert root.aggregator.received_workers() == []
+        assert root._pending_readmit == ({1} if readmission else set())
+
+
+def test_tree_rejects_oversized_topology():
+    trainer, train = _lr_fixture(workers=4)
+    with pytest.raises(ValueError, match="leaves"):
+        run_tree_fedavg_loopback(trainer, train, (4, 4), 1, 8)
+
+
+# ---------------------------------------------------------------------------
+# exp entry guards
+# ---------------------------------------------------------------------------
+
+
+def test_main_fedavg_server_mode_guards():
+    from fedml_tpu.exp import main_fedavg
+
+    import argparse
+
+    def args_for(*argv):
+        return main_fedavg.parse_with_config(
+            main_fedavg.add_args(argparse.ArgumentParser()), list(argv))
+
+    with pytest.raises(NotImplementedError, match="server_mode"):
+        main_fedavg.run(args_for("--server_mode", "async",
+                                 "--backend", "sim"))
+    with pytest.raises(NotImplementedError, match="loopback cells"):
+        main_fedavg.run(args_for("--server_mode", "tree",
+                                 "--backend", "grpc"))
+    with pytest.raises(NotImplementedError, match="encoded-update"):
+        main_fedavg.run(args_for("--server_mode", "tree",
+                                 "--backend", "loopback",
+                                 "--compressor", "q8"))
+    # the fault/retry/heartbeat/checkpoint planes are consumed by the flat
+    # runner the tree branch bypasses — silent no-ops would fake recovery
+    # or robustness experiments, so they are rejected loudly
+    with pytest.raises(NotImplementedError, match="--checkpoint_dir"):
+        main_fedavg.run(args_for("--server_mode", "tree",
+                                 "--backend", "loopback",
+                                 "--checkpoint_dir", "/tmp/nope"))
+    with pytest.raises(NotImplementedError, match="--fault_spec"):
+        main_fedavg.run(args_for("--server_mode", "tree",
+                                 "--backend", "loopback",
+                                 "--fault_spec", "2:dup=1.0"))
+    # async-only knobs under the wrong mode: rejected, not silently dropped
+    with pytest.raises(NotImplementedError, match="--staleness_weight"):
+        main_fedavg.run(args_for("--server_mode", "sync",
+                                 "--backend", "loopback",
+                                 "--staleness_weight", "poly:0.5"))
+    with pytest.raises(NotImplementedError, match="--buffer_goal"):
+        main_fedavg.run(args_for("--server_mode", "tree",
+                                 "--backend", "loopback",
+                                 "--buffer_goal", "4"))
+    with pytest.raises(NotImplementedError, match="--tree_fan_ins"):
+        main_fedavg.run(args_for("--server_mode", "async",
+                                 "--backend", "loopback",
+                                 "--tree_fan_ins", "2,2"))
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def test_async_smoke_tool_runs():
+    """tools/async_smoke.py is the tier-1 bit-identity guard the docs point
+    at — run it in-process (mirrors the wire/ft smokes' wiring)."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "async_smoke.py"
+    spec = importlib.util.spec_from_file_location("async_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# 10^4-client soak (acceptance arm; excluded from tier-1 via -m 'not slow')
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # 10^4 folds; the fast gate covers the same arithmetic at small K
+def test_async_soak_ten_thousand_uploads_per_window():
+    """One emitted-model window over 10^4 simulated client uploads: the
+    tally never retains per-client state (O(model) host memory — one f64
+    accumulator), the arrival counter tracks every fold, and the emitted
+    model equals the pure-numpy oracle bit-for-bit."""
+    clients, dim = 10_000, 1024
+    agg = AsyncFedAggregator(clients)
+
+    def upload(i):
+        rng = np.random.RandomState(i)
+        return AsyncUpload(rng.randn(dim).astype(np.float32),
+                           1.0 + (i % 7), i % 3)
+
+    s = make_staleness_fn("poly:0.5")
+    for i in range(clients):
+        up = upload(i)
+        w = float(s(2 - up.version)) * up.n
+        assert agg.fold_async(i, up.x.view(np.uint8), w, up.version)
+        # O(model): the window state is ONE f64 accumulator, never a
+        # per-client buffer (the buffered legacy shape would be ~80 GB here)
+        assert agg._acc.nbytes == dim * 8
+        assert not hasattr(agg, "model_dict")
+    assert agg.arrivals == clients
+    got = agg.emit().view(np.float32)
+    models, records = replay_async_schedule(
+        (upload(i) for i in range(clients)), buffer_goal=clients,
+        staleness=s, start_version=2,
+    )
+    np.testing.assert_array_equal(got, models[0])
+    assert records[0]["arrivals"] == clients

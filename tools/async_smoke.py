@@ -1,0 +1,154 @@
+"""Barrier-free server-plane smoke: the bit-identity arms of
+docs/PERFORMANCE.md "Barrier-free aggregation", run on the loopback fabric
+with a rank-ordered uplink so the f64 fold order is pinned:
+
+- **async-with-barrier** — ``server_mode="async"`` with ``buffer_goal ==
+  worker_num`` and the constant staleness weight: every worker parks before
+  the buffer fills, so the sync protocol re-emerges and every emitted model
+  must equal the sync streaming server's round models BIT-FOR-BIT.
+- **1-tier tree** — one edge aggregator under the root, all clients under
+  it: the edge folds uploads in the flat server's exact sequence and
+  forwards one raw f64 partial, so the root's divide-at-close must equal
+  the flat server bit-for-bit.
+
+The smoke also pins the encode-once ledger for both arms (the async arm
+serializes exactly as many payloads as sync; the tree pays one extra
+fan-out + one partial upload per round — per TIER, not per client).
+
+    JAX_PLATFORMS=cpu python tools/async_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 3
+WORKERS = 4
+
+
+def main(argv=None) -> int:
+    import jax
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.async_agg.tree import run_tree_fedavg
+    from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
+    from fedml_tpu.comm.message import reset_wire_stats, wire_stats
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(
+        n_clients=WORKERS, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+
+    def snap(v):
+        return [np.asarray(l).copy() for l in jax.tree.leaves(v)]
+
+    def run_flat(**kwargs):
+        fabric = OrderedUplinkFabric(
+            WORKERS + 1, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        )
+        per_round = []
+        reset_wire_stats()
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=WORKERS, round_num=ROUNDS,
+            batch_size=8,
+            make_comm=lambda r: LoopbackCommManager(fabric, r),
+            on_round_done=lambda r, v: per_round.append((r, snap(v))),
+            **kwargs,
+        )
+        return snap(final), per_round, wire_stats()
+
+    def run_tree():
+        # the ordered fabric pins the LEAF tier's fold order (the only cell
+        # with racing uploaders — the root has a single child)
+        def make_group(path, world):
+            if path == ():
+                from fedml_tpu.comm.loopback import LoopbackFabric
+
+                fabric = LoopbackFabric(world)
+            else:
+                fabric = OrderedUplinkFabric(
+                    world, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+                )
+            return lambda r: LoopbackCommManager(fabric, r)
+
+        per_round = []
+        reset_wire_stats()
+        final = run_tree_fedavg(
+            trainer, train, (1, WORKERS), ROUNDS, 8,
+            on_round_done=lambda r, v: per_round.append((r, snap(v))),
+            make_group_comm=make_group,
+        )
+        return snap(final), per_round, wire_stats()
+
+    sync_final, sync_rounds, sync_stats = run_flat()
+    async_final, async_rounds, async_stats = run_flat(
+        server_mode="async", buffer_goal=WORKERS, staleness_weight="const"
+    )
+    tree_final, tree_rounds, tree_stats = run_tree()
+
+    def assert_identical(arm_rounds, arm_final, arm: str):
+        assert len(arm_rounds) == len(sync_rounds) == ROUNDS, (
+            arm, len(arm_rounds), len(sync_rounds)
+        )
+        for (ra, leaves_a), (rs, leaves_s) in zip(arm_rounds, sync_rounds):
+            assert ra == rs, (arm, ra, rs)
+            for a, b in zip(leaves_a, leaves_s):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"round {ra}: {arm} != sync streaming"
+                )
+        for a, b in zip(arm_final, sync_final):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"final: {arm} != sync streaming"
+            )
+
+    assert_identical(async_rounds, async_final,
+                     "async (barrier + unit staleness + full buffer)")
+    assert_identical(tree_rounds, tree_final, "1-tier tree")
+
+    # encode-once ledgers. Flat (sync AND async-with-barrier): one
+    # serialization per downlink fan-out (init + per-round sync/stop) plus
+    # one per upload. The 1-tier tree adds ONE tier: each model fan-out is
+    # re-framed once by the edge (the final stop is forwarded payload-free,
+    # hence the -1) and each round forwards one partial upstream.
+    uplinks = ROUNDS * WORKERS
+    fanouts = ROUNDS + 1
+    expect_flat = fanouts + uplinks
+    expect_tree = (2 * fanouts - 1) + uplinks + ROUNDS
+    assert sync_stats["payload_serializations"] == expect_flat, (
+        sync_stats, expect_flat
+    )
+    assert async_stats["payload_serializations"] == expect_flat, (
+        async_stats, expect_flat
+    )
+    assert tree_stats["payload_serializations"] == expect_tree, (
+        tree_stats, expect_tree
+    )
+
+    print(
+        f"async smoke OK: {ROUNDS} rounds x {WORKERS} workers — "
+        "async(full-buffer barrier) == sync streaming bit-for-bit, "
+        "1-tier tree == flat server bit-for-bit; payload serializations "
+        f"{async_stats['payload_serializations']} (async) / "
+        f"{tree_stats['payload_serializations']} (tree, one extra tier) vs "
+        f"{sync_stats['payload_serializations']} (sync)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
