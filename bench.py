@@ -626,6 +626,53 @@ def bench_ft_overhead(n_rounds: int = 4):
     }
 
 
+def bench_fleet_overhead(n_rounds: int = 6):
+    """Fleet telemetry A/B (docs/OBSERVABILITY.md "Fleet telemetry"):
+    loopback message-passing rounds/sec with --fleet_stats ON — process
+    registry installed, clients timing + piggybacking per-upload telemetry
+    reports, the server folding them into the per-rank health view and
+    flushing a fleet snapshot per round — vs plain. Telemetry is read-only
+    (models bit-identical, tools/fleet_smoke.py), so this probe is its
+    whole cost story. Acceptance target: <= 3% rounds/sec overhead on the
+    loopback LR probe. Returns probe metrics."""
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg_loopback
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    workers = 4
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=64,
+                              num_classes=4, seed=0)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+
+    def run(**kw):
+        t0 = time.perf_counter()
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=workers, round_num=n_rounds,
+            batch_size=16, **kw,
+        )
+        return n_rounds / (time.perf_counter() - t0)
+
+    run()  # warm (compile + thread spinup), shared by both arms
+    # interleaved ABAB with best-of-passes per arm: a lone A-then-B
+    # measurement on a loaded CPU host systematically favors whichever arm
+    # runs later
+    plain_a, fleet_a = run(), run(fleet_stats={})
+    plain_rps = max(plain_a, run())
+    fleet_rps = max(fleet_a, run(fleet_stats={}))
+    return {
+        "fleet_rounds_per_sec": round(fleet_rps, 2),
+        "fleet_plain_rounds_per_sec": round(plain_rps, 2),
+        "fleet_overhead_frac": round(1.0 - fleet_rps / plain_rps, 4),
+        "fleet_workers": workers,
+    }
+
+
 def bench_async_ab(n_rounds: int = 3):
     """Barrier-free server A/B (docs/PERFORMANCE.md "Barrier-free
     aggregation"): loopback uploads/sec and models-emitted/sec for the
@@ -1183,6 +1230,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_async_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["async_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_fleet_probe"
+    try:
+        pipeline_extra.update(bench_fleet_overhead())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["fleet_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_shard_probe"
     try:

@@ -17,6 +17,7 @@ FedAvg server — cross-silo is a client-side composition, not a new protocol.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -112,6 +113,24 @@ def run_cross_silo(
             train_fns[key] = make_silo_local_train(trainer, mesh)
         return train_fns[key]
 
+    # in-process execution serialization: every silo mesh spans the SAME
+    # local devices (silo_mesh(1) above), so the silo threads' in-silo
+    # programs contend for one device set — and on XLA:CPU two concurrently
+    # dispatched GSPMD executables intermittently DEADLOCK in the runtime
+    # thread pool (both client threads stuck in _local_train forever, the
+    # pre-existing tier-1 cross-silo hang). Real cross-silo runs one
+    # process per silo; in the in-process harness the shared device set
+    # serializes execution anyway, so the lock costs no real parallelism
+    # and removes the deadlock.
+    exec_lock = threading.Lock()
+
+    def _serialized(fn):
+        def wrapped(*args):
+            with exec_lock:
+                return fn(*args)
+
+        return wrapped
+
     clients = []
     for r in range(1, n_silos + 1):
         # full participation assigns worker r the global client index r-1;
@@ -130,7 +149,7 @@ def run_cross_silo(
             FedAvgClientManager(
                 make_comm(r), r, n_silos + 1, trainer,
                 keyed, batch_size, template,
-                local_train_fn=_silo_fn(silo_meshes[r - 1]),
+                local_train_fn=_serialized(_silo_fn(silo_meshes[r - 1])),
             )
         )
     run_manager_protocol(server, clients)

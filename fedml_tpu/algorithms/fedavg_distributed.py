@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any, Callable
 
 import numpy as np
@@ -30,6 +31,7 @@ from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
 from fedml_tpu.comm.send_pool import BroadcastSendError
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.obs import registry
 from fedml_tpu.obs import trace
 from fedml_tpu.sim.cohort import FederatedArrays, stack_cohort
 
@@ -293,7 +295,8 @@ class FedAvgServerManager(ServerManager):
                  heartbeat_timeout: float | None = None,
                  readmission: bool = False,
                  checkpointer=None,
-                 checkpoint_every: int = 1):
+                 checkpoint_every: int = 1,
+                 fleet=None):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
         self.round_num = round_num
@@ -339,6 +342,14 @@ class FedAvgServerManager(ServerManager):
         from fedml_tpu.comm.status import ClientStatusTracker
 
         self.status = ClientStatusTracker(worker_num)
+        # fleet telemetry plane (obs/registry.py FleetHealth, docs/
+        # OBSERVABILITY.md "Fleet telemetry"): per-rank health records the
+        # server maintains next to the protocol state — None (the default)
+        # keeps every hook a single attribute check. Status-tracker
+        # transitions (ONLINE/SLOW/OFFLINE) land on the rank's timeline.
+        self.fleet = fleet
+        if fleet is not None:
+            self.status.on_transition = fleet.record_state
         self._round_timer: "threading.Timer | None" = None
         self._round_lock = threading.Lock()
         import json
@@ -519,6 +530,7 @@ class FedAvgServerManager(ServerManager):
         flat = self._decode_upload(msg)
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
         upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        tel = msg.get(Message.MSG_ARG_KEY_TELEMETRY)
         # staleness/exclusion checks and the tally are one critical section:
         # a timer closing the round between them would otherwise let a
         # round-r model slip into round r+1's tally
@@ -550,6 +562,11 @@ class FedAvgServerManager(ServerManager):
                 # silent): Comm/StaleUploads is the observability baseline
                 # the async server's staleness weighting builds on.
                 self.stale_uploads += 1
+                if self.fleet is not None:
+                    self.fleet.counter(sender, "stale_uploads")
+                    self.fleet.observe(sender, "staleness",
+                                       current - int(upload_round))
+                    self.fleet.merge_report(sender, tel)
                 logging.info(
                     "discarding stale upload from worker %d (upload_round=%s,"
                     " current=%d; Comm/StaleUploads=%d this run — the async "
@@ -561,6 +578,10 @@ class FedAvgServerManager(ServerManager):
             all_received = self.aggregator.add_local_trained_result(
                 sender - 1, flat, n
             )
+            if self.fleet is not None:
+                self.fleet.counter(sender, "uploads")
+                self.fleet.observe(sender, "staleness", 0)
+                self.fleet.merge_report(sender, tel)
             self._miss_counts.pop(sender - 1, None)  # it spoke: reset misses
             if not all_received and self.round_timeout is not None:
                 if self._round_timer is None:
@@ -649,6 +670,13 @@ class FedAvgServerManager(ServerManager):
                 for w in sorted(self._pending_readmit):
                     self.aggregator.readmit_worker(w)
                     self._miss_counts.pop(w, None)
+                    if self.fleet is not None:
+                        # the distinct timeline event BEFORE the tracker
+                        # flips the state back: ... OFFLINE, READMITTED,
+                        # ONLINE — an operator can tell a returnee apart
+                        self.fleet.record_state(w + 1,
+                                                registry.STATE_READMITTED)
+                        self.fleet.counter(w + 1, "readmissions")
                     self.status.update(w + 1, ClientStatus.ONLINE,
                                        touch=False)
                     readmitted.append(w + 1)
@@ -675,6 +703,23 @@ class FedAvgServerManager(ServerManager):
         self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                            [w + 1 for w in self.aggregator.live_workers()],
                            cohort=cohort)
+
+    # -- fleet telemetry (docs/OBSERVABILITY.md "Fleet telemetry") -----------
+
+    def _fleet_round_record(self, round_idx: int) -> dict | None:
+        """Flush heartbeat freshness into the fleet view and return the
+        cumulative fleet snapshot stamped with ``round_idx`` — the per-round
+        JSONL record the runner appends to ``fleet_stats['rounds']``. None
+        when fleet telemetry is off."""
+        if self.fleet is None:
+            return None
+        now = time.monotonic()
+        for w in self.aggregator.live_workers():
+            seen = self.status.last_seen(w + 1)
+            if seen is not None:
+                self.fleet.gauge(w + 1, "heartbeat_age_s",
+                                 round(now - seen, 4))
+        return self.fleet.round_record(round_idx)
 
     # -- crash recovery (docs/ROBUSTNESS.md "Failure recovery") --------------
 
@@ -755,6 +800,11 @@ class FedAvgClientManager(ClientManager):
         # harness points rng_rank at the GLOBAL leaf number instead so their
         # local-train key chains never collide (flat runs: rng_rank == rank)
         self.rng_rank = rank
+        # fleet telemetry opt-in (set by the runner when fleet_stats is on):
+        # piggybacking must not key on the process registry alone — a
+        # registry installed for unrelated gauges must never change what
+        # goes on the wire
+        self.fleet_telemetry = False
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
@@ -786,6 +836,13 @@ class FedAvgClientManager(ClientManager):
         if msg.get("finished"):
             self.finish()
             return
+        # fleet telemetry (obs/registry.py, docs/OBSERVABILITY.md "Fleet
+        # telemetry"): when this client opted in AND a process registry is
+        # installed, time the local round and piggyback a compact report on
+        # the upload; the disabled path costs one attribute check and adds
+        # NO wire field
+        reg = registry.get() if self.fleet_telemetry else None
+        t_start = time.perf_counter() if reg is not None else 0.0
         # the explicit model-version stamp (async server mode,
         # docs/PERFORMANCE.md "Barrier-free aggregation"): remembered here
         # and ECHOED on the upload, so the server's staleness weight is
@@ -821,6 +878,18 @@ class FedAvgClientManager(ClientManager):
         if getattr(self, "_model_version", None) is not None:
             out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION,
                            self._model_version)
+        if reg is not None:
+            step_ms = (time.perf_counter() - t_start) * 1e3
+            reg.observe("client/step_ms", step_ms)
+            reg.counter("client/rounds")
+            # header-only JSON scalars (never payload); "retries" is this
+            # manager's cumulative count as of the PREVIOUS send — the
+            # current send's re-attempts land on the next round's report
+            out.add_params(Message.MSG_ARG_KEY_TELEMETRY, {
+                "step_ms": round(step_ms, 3),
+                "sent_at": time.time(),
+                "retries": self.comm_retries,
+            })
         self.send_message(out)
 
 
@@ -1047,6 +1116,7 @@ def run_distributed_fedavg(
     buffer_goal: int | None = None,
     staleness_weight: str = "const",
     async_stats: dict | None = None,
+    fleet_stats: dict | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -1089,7 +1159,16 @@ def run_distributed_fedavg(
     path reproduces the sync streaming path bit-for-bit
     (tools/async_smoke.py holds the contract). The hierarchical-tree mode
     has its own harness (async_agg.tree.run_tree_fedavg_loopback).
-    Returns the final global variables."""
+
+    ``fleet_stats`` (a caller dict) switches on the fleet telemetry plane
+    (docs/OBSERVABILITY.md "Fleet telemetry"): the server grows a per-rank
+    health view (obs/registry.py FleetHealth), clients piggyback compact
+    telemetry reports on their uploads, and the dict receives per-round
+    fleet snapshots (``rounds``), the final fleet view (``totals``), and
+    the process MetricRegistry snapshot (``registry``). Read-only:
+    telemetry-on runs are bit-identical to telemetry-off runs
+    (tools/fleet_smoke.py holds the contract). Returns the final global
+    variables."""
     if server_mode not in ("sync", "async"):
         raise ValueError(
             f"unknown server_mode {server_mode!r}: expected 'sync' or "
@@ -1151,6 +1230,15 @@ def run_distributed_fedavg(
         ckptr = RoundCheckpointer(checkpoint_dir)
         ft_kwargs["checkpointer"] = ckptr
         ft_kwargs["checkpoint_every"] = checkpoint_every
+    fleet = None
+    _sysstats = None
+    if fleet_stats is not None:
+        from fedml_tpu.obs.registry import FleetHealth
+        from fedml_tpu.obs.sysstats import SysStats
+
+        fleet = FleetHealth()
+        ft_kwargs["fleet"] = fleet
+        _sysstats = SysStats()
     if ft_kwargs:
         # explicit caller server_kwargs still win over the derived knobs
         server_kwargs = {**ft_kwargs, **(server_kwargs or {})}
@@ -1220,6 +1308,15 @@ def run_distributed_fedavg(
             comm_stats.setdefault("rounds", []).append(
                 server.accountant.round_record(r)
             )
+        if fleet_stats is not None:
+            # same ordering contract as comm_stats: the fleet record is
+            # flushed BEFORE on_round_done so a by-round metrics merge (or
+            # an incremental JSONL writer) finds it
+            if _sysstats is not None:
+                _sysstats.publish_device_gauges()
+            rec = server._fleet_round_record(r)
+            if rec is not None:
+                fleet_stats.setdefault("rounds", []).append(rec)
         if on_round_done is not None:
             on_round_done(r, unpack_pytree(f, desc))
 
@@ -1239,6 +1336,11 @@ def run_distributed_fedavg(
                 # every round already closed before the crash: nothing to
                 # re-run — the checkpointed global IS the final model
                 server.comm.stop_receive_message()
+                if fleet_stats is not None:
+                    # nothing ran, but the caller still gets a renderable
+                    # (empty) fleet view instead of a null totals key that
+                    # crashes tools/fleet_report.py
+                    fleet_stats["totals"] = fleet.snapshot()
                 return unpack_pytree(server.global_flat, desc)
         else:
             logging.info("resume requested but no server checkpoint under "
@@ -1251,6 +1353,9 @@ def run_distributed_fedavg(
         )
         for r in range(1, worker_num + 1)
     ]
+    if fleet_stats is not None:
+        for c in clients:
+            c.fleet_telemetry = True
 
     from fedml_tpu.comm.retry import retry_stats
 
@@ -1263,11 +1368,25 @@ def run_distributed_fedavg(
             HeartbeatSender(c.comm, c.rank, heartbeat_interval).start()
             for c in clients
         ]
+    # fleet telemetry needs the process registry installed so clients
+    # collect + piggyback; reuse an outer scope's registry when one exists
+    _installed_registry = None
+    if fleet_stats is not None and registry.get() is None:
+        _installed_registry = registry.install()
     try:
         run_manager_protocol(server, clients)
     finally:
         for hb in heartbeats:
             hb.stop()
+        if fleet_stats is not None:
+            if fleet is not None:
+                fleet_stats["totals"] = fleet.snapshot()
+            reg = registry.get()
+            if reg is not None:
+                fleet_stats["registry"] = reg.snapshot()
+            if _installed_registry is not None \
+                    and registry.get() is _installed_registry:
+                registry.uninstall()
     if comm_stats is not None:
         from fedml_tpu.obs import metrics as metricslib
 

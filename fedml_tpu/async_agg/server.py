@@ -34,6 +34,7 @@ mid-window arrival counter + idempotence guard through the PR 8
 from __future__ import annotations
 
 import logging
+import time
 
 import numpy as np
 
@@ -51,6 +52,7 @@ from fedml_tpu.algorithms.robust_distributed import (
 from fedml_tpu.async_agg.staleness import make_staleness_fn
 from fedml_tpu.comm.message import Message
 from fedml_tpu.obs import metrics as metricslib
+from fedml_tpu.obs import registry
 from fedml_tpu.obs import trace
 
 
@@ -177,6 +179,13 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         self._staleness_fn = make_staleness_fn(self.staleness_weight)
         self._async_stats = async_stats
         self._parked: set[int] = set()  # workers awaiting the next emission
+        self._fleet_t0 = time.monotonic()  # liveness epoch for never-seen ranks
+        if self.fleet is not None:
+            # route tracker transitions through the readmission-aware hook:
+            # in async mode a written-off worker's FIRST new contact (a
+            # heartbeat) flips it ONLINE via the tracker, and the operator
+            # timeline must show the READMITTED event on that path too
+            self.status.on_transition = self._fleet_transition
         # per-emission-window counters + run totals (Async/* metrics)
         self._window = {"stale": 0, "dup": 0, "staleness_sum": 0}
         self._totals = {"stale": 0, "dup": 0, "emitted": 0}
@@ -199,6 +208,7 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         sender = msg.get_sender_id()
         flat = self._decode_upload(msg)
         n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+        tel = msg.get(Message.MSG_ARG_KEY_TELEMETRY)
         # prefer the client's explicit version echo (the downlink stamp it
         # verifiably trained against); the authoritative round index it
         # trained AS is the compatible fallback — identical in value, but
@@ -229,14 +239,25 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                 # duplicate/replayed (sender, version) leg: idempotent drop
                 self._window["dup"] += 1
                 self._totals["dup"] += 1
+                if self.fleet is not None:
+                    self.fleet.counter(sender, "dup_uploads")
                 logging.info(
                     "absorbed duplicate upload from worker %d (version %d "
                     "already folded)", sender, u,
                 )
                 return
+            if self.fleet is not None:
+                # per-rank fold record: the union of these histograms IS
+                # the per-emission staleness distribution the fleet report
+                # renders (docs/OBSERVABILITY.md "Fleet telemetry")
+                self.fleet.counter(sender, "uploads")
+                self.fleet.observe(sender, "staleness", staleness)
+                self.fleet.merge_report(sender, tel)
             if staleness > 0:
                 self._window["stale"] += 1
                 self._totals["stale"] += 1
+                if self.fleet is not None:
+                    self.fleet.counter(sender, "stale_folds")
                 self._window["staleness_sum"] += staleness
             emitted = False
             record = None
@@ -278,6 +299,11 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         if ckpt_state is not None:
             self._write_checkpoint(ckpt_state)
         if record is not None:
+            # emission boundary = the async analogue of a round close: the
+            # fleet liveness sweep runs here so the per-emission fleet
+            # record (flushed by the runner's on_round_done wrapper) carries
+            # a current timeline
+            self._fleet_liveness_sweep()
             if self._async_stats is not None:
                 self._async_stats.setdefault("rounds", []).append(record)
             if self.on_round_done:
@@ -318,6 +344,60 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
             "; ".join(f"{d}: {type(e).__name__}: {e}"
                       for d, e in sorted(errors.items())),
         )
+
+    def _fleet_liveness_sweep(self, now: float | None = None) -> None:
+        """Classify every worker's heartbeat age into the FLEET VIEW's
+        health timeline. Async mode has no round barrier, so nothing ever
+        marks a worker SLOW/OFFLINE protocol-wise (liveness is
+        heartbeats-only, docs/ROBUSTNESS.md) — but the operator still needs
+        the timeline, so each emission classifies by heartbeat age:
+
+        - age > ``heartbeat_timeout``        -> SLOW
+        - age > 3 x ``heartbeat_timeout``    -> OFFLINE
+        - fresh again after OFFLINE          -> READMITTED, then ONLINE
+
+        READ-ONLY by the fleet contract: states land on the fleet view
+        only; the status tracker, the live set, and the dispatch discipline
+        are never touched, so a swept run stays bit-identical to an
+        unswept one. A rank that never made contact ages from server start
+        (a worker dark from minute zero must not read as healthy).
+        ``now`` is injectable for deterministic tests."""
+        from fedml_tpu.comm.status import ClientStatus
+
+        if self.fleet is None or self.heartbeat_timeout is None:
+            return
+        t = time.monotonic() if now is None else now
+        for w in range(self.worker_num):
+            rank = w + 1
+            seen = self.status.last_seen(rank)
+            age = t - (self._fleet_t0 if seen is None else seen)
+            prev = self.fleet.state(rank)
+            if age > 3.0 * self.heartbeat_timeout:
+                if prev not in (ClientStatus.SLOW, ClientStatus.OFFLINE):
+                    # aging is monotonic: a rank seen only after it crossed
+                    # the OFFLINE threshold still passed through the SLOW
+                    # band — keep the degradation path on the timeline
+                    self.fleet.record_state(rank, ClientStatus.SLOW)
+                self.fleet.record_state(rank, ClientStatus.OFFLINE)
+            elif age > self.heartbeat_timeout:
+                if prev != ClientStatus.OFFLINE:
+                    self.fleet.record_state(rank, ClientStatus.SLOW)
+            else:
+                self._fleet_transition(rank, ClientStatus.ONLINE)
+
+    def _fleet_transition(self, rank: int, status: str) -> None:
+        """Fleet-view state recorder (also the tracker's ``on_transition``
+        hook in async mode): a worker the fleet wrote OFF that makes
+        contact again gets the distinct READMITTED event before ONLINE —
+        same operator convention as the sync server's readmission branch,
+        but triggered by contact, since async mode never excludes."""
+        from fedml_tpu.comm.status import ClientStatus
+
+        if (status == ClientStatus.ONLINE and self.fleet.state(rank)
+                == ClientStatus.OFFLINE):
+            self.fleet.record_state(rank, registry.STATE_READMITTED)
+            self.fleet.counter(rank, "readmissions")
+        self.fleet.record_state(rank, status)
 
     def async_totals(self) -> dict:
         return {

@@ -28,6 +28,7 @@ import dataclasses
 import logging
 import math
 import threading
+import time
 from typing import Callable
 
 import numpy as np
@@ -42,6 +43,7 @@ from fedml_tpu.algorithms.fedavg_distributed import (
 from fedml_tpu.comm.managers import DistributedManager
 from fedml_tpu.comm.message import Message, unpack_pytree
 from fedml_tpu.core import rng as rnglib
+from fedml_tpu.obs import registry
 from fedml_tpu.obs import trace
 
 
@@ -174,6 +176,15 @@ class EdgeAggregatorManager(DistributedManager):
         self.duplicate_uploads = 0
         self.discarded_folds = 0
         self.stale_syncs = 0
+        # fleet telemetry (obs/registry.py): cumulative folds forwarded and
+        # the current window's fill-start time — the tier's "local step
+        # time" is first-fold -> forward. Collected only when the runner
+        # opted this tier in (fleet_telemetry, the same explicit switch as
+        # FedAvgClientManager — a process registry installed for unrelated
+        # gauges must never change what goes on the wire).
+        self.fleet_telemetry = False
+        self.total_folds = 0
+        self._window_t0: float | None = None
         self._round = 0
         # per-child round of the last ACCEPTED contribution: the tally's
         # first-wins flags reset when the tier forwards its partial, but the
@@ -222,6 +233,7 @@ class EdgeAggregatorManager(DistributedManager):
             self.up_comm.send_message(msg)
         else:
             policy.run(lambda: self.up_comm.send_message(msg),
+                       on_retry=self._note_retry,
                        dst=msg.get_receiver_id(), msg_type=msg.get_type())
 
     # -- downlink: parent sync re-broadcast ----------------------------------
@@ -328,6 +340,8 @@ class EdgeAggregatorManager(DistributedManager):
             sender = msg.get_sender_id()
             flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
             n = float(msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES))
+            if self.fleet_telemetry and self._window_t0 is None:
+                self._window_t0 = time.perf_counter()
             with trace.span("tree/fold", kind="model", sender=sender,
                             round=self._round):
                 done = self.aggregator.add_local_trained_result(
@@ -344,6 +358,8 @@ class EdgeAggregatorManager(DistributedManager):
             part = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
             wsum = float(msg.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
             folds = msg.get(TreeMessage.MSG_ARG_KEY_FOLD_COUNT)
+            if self.fleet_telemetry and self._window_t0 is None:
+                self._window_t0 = time.perf_counter()
             with trace.span("tree/fold", kind="partial", sender=sender,
                             round=self._round,
                             child_folds=int(folds) if folds is not None
@@ -356,6 +372,7 @@ class EdgeAggregatorManager(DistributedManager):
 
     def _forward_partial(self) -> None:
         partial, wsum, count = self.aggregator.partial()
+        self.total_folds += int(count)
         with trace.span("tree/forward", round=self._round, folds=count,
                         bytes=int(partial.nbytes)):
             out = Message(TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL,
@@ -364,6 +381,25 @@ class EdgeAggregatorManager(DistributedManager):
             out.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, float(wsum))
             out.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, int(count))
             out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round)
+            if self.fleet_telemetry:
+                # the tier's piggybacked health report (docs/OBSERVABILITY.md
+                # "Fleet telemetry"): window fill time as the tier's step
+                # time, send stamp for upload latency, and the cumulative
+                # tier counters the root records as per-tier gauges
+                tel: dict = {"sent_at": time.time(),
+                             "retries": self.comm_retries,
+                             "counts": {
+                                 "folds_total": self.total_folds,
+                                 "stale_uploads": self.stale_uploads,
+                                 "dup_uploads": self.duplicate_uploads,
+                                 "discarded_folds": self.discarded_folds,
+                                 "stale_syncs": self.stale_syncs,
+                             }}
+                if self._window_t0 is not None:
+                    tel["step_ms"] = round(
+                        (time.perf_counter() - self._window_t0) * 1e3, 3)
+                self._window_t0 = None
+                out.add_params(Message.MSG_ARG_KEY_TELEMETRY, tel)
             self._send_up(out)
 
 
@@ -401,6 +437,7 @@ class TreeFedAvgServerManager(FedAvgServerManager):
         wsum = float(msg.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
         folds = msg.get(TreeMessage.MSG_ARG_KEY_FOLD_COUNT)
         upload_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
+        tel = msg.get(Message.MSG_ARG_KEY_TELEMETRY)
         with self._round_lock:
             current = self.round_idx
             if not self.aggregator.is_live(sender - 1):
@@ -425,6 +462,11 @@ class TreeFedAvgServerManager(FedAvgServerManager):
                 return
             if upload_round is not None and int(upload_round) != current:
                 self.stale_uploads += 1
+                if self.fleet is not None:
+                    self.fleet.counter(sender, "stale_uploads")
+                    self.fleet.observe(sender, "staleness",
+                                       current - int(upload_round))
+                    self.fleet.merge_report(sender, tel)
                 logging.info(
                     "discarding stale partial from tier %d (upload_round=%s, "
                     "current=%d; Comm/StaleUploads=%d this run)",
@@ -439,6 +481,15 @@ class TreeFedAvgServerManager(FedAvgServerManager):
                 all_received = self.aggregator.add_partial_result(
                     sender - 1, part, wsum
                 )
+            if self.fleet is not None:
+                # per-TIER health record: each partial is one upload; the
+                # fold count is the number of client updates this tier's
+                # super-update represents (the edge's cumulative counters
+                # arrive as gauges through the piggybacked report)
+                self.fleet.counter(sender, "uploads")
+                if folds is not None:
+                    self.fleet.observe(sender, "folds", int(folds))
+                self.fleet.merge_report(sender, tel)
             self._miss_counts.pop(sender - 1, None)
             if not all_received and self.round_timeout is not None:
                 if self._round_timer is None:
@@ -476,6 +527,7 @@ def run_tree_fedavg(
     make_group_comm: Callable[[tuple, int], Callable[[int], object]] | None = None,
     server_kwargs: dict | None = None,
     join_timeout: float = 30.0,
+    fleet_stats: dict | None = None,
 ):
     """End-to-end hierarchical FedAvg: root -> edge tiers -> leaf clients,
     one comm group (fabric) per parent/children cell. ``make_group_comm
@@ -484,6 +536,10 @@ def run_tree_fedavg(
     backend with the BaseCommunicationManager contract slots in (the cells
     are independent, so tiers can even mix transports). ``group_path`` is
     ``()`` for the root cell and the tuple of child indices below it.
+    ``fleet_stats`` (a caller dict) switches on fleet telemetry keyed by
+    TIER rank at the root — per-tier fold/discard counts, window fill
+    times, upload latency (docs/OBSERVABILITY.md "Fleet telemetry") — with
+    the same ``rounds``/``totals``/``registry`` shape as the flat runner.
     Returns the final global variables (the flat server's return shape)."""
     topo = topology if isinstance(topology, TreeTopology) else TreeTopology(tuple(topology))
     make_group = make_group_comm or _loopback_group_comm
@@ -499,8 +555,19 @@ def run_tree_fedavg(
                                          init_overrides=init_overrides)
     results: dict[str, np.ndarray] = {}
 
+    fleet = None
+    if fleet_stats is not None:
+        from fedml_tpu.obs.registry import FleetHealth
+
+        fleet = FleetHealth()
+        server_kwargs = {"fleet": fleet, **(server_kwargs or {})}
+
     def _done(r, f):
         results["final"] = f
+        if fleet_stats is not None:
+            rec = server._fleet_round_record(r)
+            if rec is not None:
+                fleet_stats.setdefault("rounds", []).append(rec)
         if on_round_done is not None:
             on_round_done(r, unpack_pytree(f, desc))
 
@@ -551,20 +618,42 @@ def run_tree_fedavg(
     for i in range(fan[0]):
         leaf_base += build((i,), root_make, i + 1, 1, leaf_base)
 
+    if fleet_stats is not None:
+        # the reporting units are the TIERS (the root's fleet view is keyed
+        # by tier rank and only reads telemetry off partials); opting leaf
+        # clients in would spend timing + wire bytes on reports no edge
+        # handler consumes
+        for m in managers:
+            if isinstance(m, EdgeAggregatorManager):
+                m.fleet_telemetry = True
     threads = [threading.Thread(target=m.run, daemon=True) for m in managers]
     for t in threads:
         t.start()
     server.register_message_receive_handlers()
-    server.send_init_msg()
+    _installed_registry = None
+    if fleet_stats is not None and registry.get() is None:
+        _installed_registry = registry.install()
     try:
-        server.comm.handle_receive_message()
-    except BaseException:
-        for m in managers:
-            try:
-                m.finish()
-            except Exception:  # noqa: BLE001 — best-effort unblock
-                pass
-        raise
+        server.send_init_msg()
+        try:
+            server.comm.handle_receive_message()
+        except BaseException:
+            for m in managers:
+                try:
+                    m.finish()
+                except Exception:  # noqa: BLE001 — best-effort unblock
+                    pass
+            raise
+    finally:
+        if fleet_stats is not None:
+            if fleet is not None:
+                fleet_stats["totals"] = fleet.snapshot()
+            reg = registry.get()
+            if reg is not None:
+                fleet_stats["registry"] = reg.snapshot()
+            if _installed_registry is not None \
+                    and registry.get() is _installed_registry:
+                registry.uninstall()
     for t in threads:
         t.join(timeout=join_timeout)
     return unpack_pytree(results["final"], desc)

@@ -72,6 +72,12 @@ class DistributedManager(Observer):
         self.rank = rank
         self.size = size
         self._handlers: dict[int, Callable[[Message], None]] = {}
+        # this manager's cumulative re-attempt count (comm/retry.py): the
+        # per-rank view of the process-wide retry ledger, piggybacked on
+        # uploads by the fleet telemetry plane (docs/OBSERVABILITY.md
+        # "Fleet telemetry"). Plain int += under the GIL — sends on one
+        # manager are serialized anyway.
+        self.comm_retries = 0
         comm.add_observer(self)
 
     # reference API names kept (client_manager.py:55-95)
@@ -96,6 +102,7 @@ class DistributedManager(Observer):
         else:
             send = lambda: policy.run(  # noqa: E731
                 lambda: self.comm.send_message(msg),
+                on_retry=self._note_retry,
                 dst=msg.get_receiver_id(), msg_type=msg.get_type(),
             )
         tracer = trace.get()
@@ -128,6 +135,9 @@ class DistributedManager(Observer):
                          sender=self.rank, receivers=len(receiver_ids),
                          bytes=msg.payload_nbytes()):
             self.comm.broadcast_message(msg, receiver_ids, per_receiver)
+
+    def _note_retry(self) -> None:
+        self.comm_retries += 1
 
     def register_message_receive_handlers(self) -> None:
         raise NotImplementedError

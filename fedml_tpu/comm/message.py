@@ -82,6 +82,13 @@ class Message:
     MSG_ARG_KEY_MODEL_VERSION = "model_version"
     MSG_ARG_KEY_WEIGHT_SUM = "weight_sum"
     MSG_ARG_KEY_FOLD_COUNT = "fold_count"
+    # fleet telemetry plane (fedml_tpu/obs/registry.py, docs/OBSERVABILITY.md
+    # "Fleet telemetry"): a compact JSON-safe dict of sender-side health
+    # metrics piggybacked on ordinary uploads/partials — header-only scalars
+    # (never an array segment), OPTIONAL (absent = zero wire overhead), and
+    # never read by the aggregation path, so telemetry-on runs stay
+    # bit-identical to telemetry-off runs
+    MSG_ARG_KEY_TELEMETRY = "telemetry"
 
     def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {

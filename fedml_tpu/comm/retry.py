@@ -130,10 +130,14 @@ class RetryPolicy:
             raise failure[0]
         return result[0] if result else None
 
-    def run(self, fn: Callable[[], None], **attrs):
+    def run(self, fn: Callable[[], None], on_retry: Callable[[], None] | None = None,
+            **attrs):
         """Run ``fn`` with retries. ``attrs`` (e.g. dst/msg_type) annotate
-        the ``comm/retry`` telemetry. Raises the LAST error once
-        ``max_attempts`` is exhausted."""
+        the ``comm/retry`` telemetry; ``on_retry`` (optional) fires once per
+        re-attempt — the per-MANAGER attribution hook the fleet telemetry
+        plane uses (the module ledger is process-wide, which cannot tell one
+        in-process rank's retries from another's). Raises the LAST error
+        once ``max_attempts`` is exhausted."""
         for attempt in range(1, self.max_attempts + 1):
             try:
                 return self._attempt(fn)
@@ -148,6 +152,8 @@ class RetryPolicy:
                                 error=type(e).__name__, **attrs)
                     raise
                 total = _count("retries")
+                if on_retry is not None:
+                    on_retry()
                 trace.counter("comm/retry_count", total)
                 with trace.span("comm/retry", attempt=attempt,
                                 error=type(e).__name__, **attrs):

@@ -66,6 +66,14 @@ class ClientStatusTracker:
         self._last_seen: dict[int, float] = {}
         self._lock = threading.Lock()
         self._all_online = threading.Event()
+        # fleet telemetry hook (obs/registry.py FleetHealth): called as
+        # ``on_transition(client_id, status)`` whenever a client's recorded
+        # status CHANGES (heartbeats re-asserting ONLINE refresh last_seen
+        # without firing it). Invoked UNDER the tracker lock so concurrent
+        # updates (timer marking SLOW vs receive thread marking ONLINE)
+        # deliver transitions in the order the table recorded them — the
+        # hook must not call back into the tracker.
+        self.on_transition = None
 
     def update(self, client_id: int, status: str, touch: bool = True) -> None:
         """Record ``status`` for the client. ``touch=False`` marks a
@@ -73,12 +81,15 @@ class ClientStatusTracker:
         ``last_seen`` — only actual contact from the client may count as
         liveness evidence."""
         with self._lock:
+            prev = self._status.get(client_id)
             self._status[client_id] = status
             if touch:
                 self._last_seen[client_id] = time.monotonic()
             online = sum(1 for s in self._status.values() if s == ClientStatus.ONLINE)
             if online >= self.expected:
                 self._all_online.set()
+            if self.on_transition is not None and status != prev:
+                self.on_transition(client_id, status)
 
     def stale(self, timeout: float) -> list[int]:
         """Clients silent for longer than ``timeout`` seconds (and not

@@ -237,9 +237,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--profile_dir", type=str, default=None,
                         help="capture a jax.profiler trace of the round loop")
     # observability
+    from fedml_tpu.obs.registry import add_cli_flag as add_fleet_cli_flag
     from fedml_tpu.obs.trace import add_cli_flag as add_trace_cli_flag
 
     add_trace_cli_flag(parser)
+    add_fleet_cli_flag(parser)
     parser.add_argument("--run_dir", type=str, default=None)
     parser.add_argument("--enable_wandb", type=int, default=0)
     parser.add_argument("--checkpoint_dir", type=str, default=None)
@@ -415,6 +417,15 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     comm_stats: dict = {}
     robust_stats: dict = {}
     async_stats: dict = {}
+    # fleet telemetry plane (obs/registry.py, docs/OBSERVABILITY.md "Fleet
+    # telemetry"): the runner fills the dict with per-round fleet
+    # snapshots + totals; this entry persists them as fleet.jsonl/.json in
+    # the --fleet_stats dir for tools/fleet_report.py. Read-only: results
+    # are bit-identical with the flag off (tools/fleet_smoke.py).
+    fleet_stats: dict | None = (
+        {} if getattr(args, "fleet_stats", None) else None
+    )
+    fleet_kwargs = {"fleet_stats": fleet_stats} if fleet_stats is not None else {}
     robust_kwargs: dict = {}
     if args.algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
@@ -504,6 +515,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         final_variables = run_tree_fedavg_loopback(
             trainer, ds.train, topo, cfg.comm_round, cfg.batch_size,
             seed=cfg.seed, on_round_done=on_round, init_overrides=overrides,
+            **fleet_kwargs,
         )
     else:
         mode_kwargs = {}
@@ -527,11 +539,34 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             **robust_kwargs,
             **ft_kwargs,
             **mode_kwargs,
+            **fleet_kwargs,
         )
     if comm_stats.get("totals"):
         logging.info("bytes on wire: %s", comm_stats["totals"])
     if async_stats.get("totals"):
         logging.info("async server: %s", async_stats["totals"])
+    if fleet_stats is not None:
+        import json
+        import os
+
+        from fedml_tpu.obs.registry import FLEET_JSONL_NAME
+
+        out_dir = args.fleet_stats
+        os.makedirs(out_dir, exist_ok=True)
+        jsonl = os.path.join(out_dir, FLEET_JSONL_NAME)
+        with open(jsonl, "w") as f:
+            for rec in fleet_stats.get("rounds", []):
+                f.write(json.dumps(rec) + "\n")
+        with open(os.path.join(out_dir, "fleet.json"), "w") as f:
+            # the per-round snapshots live in fleet.jsonl only — each one is
+            # a full cumulative fleet view, so duplicating the list here
+            # would double the disk footprint for nothing
+            json.dump({"totals": fleet_stats.get("totals"),
+                       "registry": fleet_stats.get("registry"),
+                       "rounds_recorded": len(fleet_stats.get("rounds", []))},
+                      f)
+        logging.info("fleet telemetry written to %s (render: python "
+                     "tools/fleet_report.py %s)", out_dir, jsonl)
     if getattr(args, "save_params_to", None):
         from fedml_tpu.obs.checkpoint import save_params
 
@@ -567,6 +602,13 @@ def _run(args) -> list[dict]:
         raise NotImplementedError(
             "--fault_spec injects wire faults — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
+        )
+    if getattr(args, "fleet_stats", None) and args.backend == "sim":
+        raise NotImplementedError(
+            "--fleet_stats records per-CLIENT wire/health telemetry — on "
+            "--backend sim there are no client processes or uploads to "
+            "observe; pick --backend loopback|shm|grpc|mqtt_s3 (the sim "
+            "engine's observability is --trace_dir, docs/OBSERVABILITY.md)"
         )
     server_mode = getattr(args, "server_mode", "sync")
     if server_mode != "sync":

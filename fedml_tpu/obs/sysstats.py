@@ -59,11 +59,45 @@ class SysStats:
                 pass
         # device (HBM) stats — the TPU analogue of gpu util/mem/temp/power
         for i, dev in enumerate(jax.local_devices()):
-            try:
-                ms = dev.memory_stats()
-            except Exception:
-                ms = None
+            ms = _device_memory_stats(dev)
             if ms:
                 out[f"device{i}_bytes_in_use"] = ms.get("bytes_in_use")
                 out[f"device{i}_bytes_limit"] = ms.get("bytes_limit")
+                if ms.get("peak_bytes_in_use") is not None:
+                    out[f"device{i}_peak_bytes_in_use"] = ms["peak_bytes_in_use"]
         return out
+
+    def publish_device_gauges(self) -> dict[str, int]:
+        """JAX device-memory gauges for the fleet telemetry plane
+        (docs/OBSERVABILITY.md "Fleet telemetry"): live and peak HBM bytes
+        per local device from ``Device.memory_stats()``, published into the
+        installed :mod:`fedml_tpu.obs.registry` (silently skipped when none
+        is installed). On backends without allocator stats — XLA:CPU —
+        ``memory_stats()`` is None/unsupported and this is a silent no-op.
+        Returns the gauges it published (empty on CPU)."""
+        from fedml_tpu.obs import registry
+
+        reg = registry.get()
+        out: dict[str, int] = {}
+        for i, dev in enumerate(jax.local_devices()):
+            ms = _device_memory_stats(dev)
+            if not ms:
+                continue
+            for key in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit"):
+                v = ms.get(key)
+                if v is None:
+                    continue
+                name = f"device{i}/{key}"
+                out[name] = int(v)
+                if reg is not None:
+                    reg.gauge(name, int(v))
+        return out
+
+
+def _device_memory_stats(dev) -> dict | None:
+    """``dev.memory_stats()`` or None — absent/unsupported allocators
+    (XLA:CPU) must never raise out of a telemetry path."""
+    try:
+        return dev.memory_stats()
+    except Exception:
+        return None

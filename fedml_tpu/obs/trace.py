@@ -111,18 +111,24 @@ class Tracer:
 
     PID = 1  # single-process tracer; one Chrome process track
 
-    # events kept in memory before recording stops (~150 bytes each →
-    # ~300 MB worst case). A week-long traced run must degrade to a
-    # truncated trace, not eat the host; exports report the drop count.
+    # events kept in memory while recording (~150 bytes each → ~300 MB
+    # worst case). The buffer is a RING: once full, the OLDEST events are
+    # evicted, so a multi-hour traced run keeps the most recent window (the
+    # part an operator debugging "why did it just get slow" actually wants)
+    # at bounded memory; ``dropped`` counts evictions and both exporters
+    # surface it as a ``trace/dropped_events`` counter record.
     DEFAULT_MAX_EVENTS = 2_000_000
+    DROPPED_EVENT_NAME = "trace/dropped_events"
 
     def __init__(self, max_events: int | None = None):
+        from collections import deque
+
         self._clock = time.perf_counter
         self._t0 = self._clock()
         self._lock = threading.Lock()
-        self._events: list[dict] = []
         self._max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
                             else int(max_events))
+        self._events: "deque[dict]" = deque(maxlen=self._max_events)
         self.dropped = 0
         self._thread_ids: dict[int, int] = {}
         self._thread_names: dict[int, str] = {}
@@ -130,9 +136,9 @@ class Tracer:
     def _record(self, rec: dict) -> None:
         with self._lock:
             if len(self._events) >= self._max_events:
-                self.dropped += 1
-                return
-            self._events.append(rec)
+                self.dropped += 1  # deque evicts the oldest on append
+            if self._max_events > 0:
+                self._events.append(rec)
 
     # -- recording -----------------------------------------------------------
 
@@ -195,6 +201,18 @@ class Tracer:
         with self._lock:
             return list(self._events)
 
+    def _dropped_record(self) -> dict | None:
+        """The exporter-surfaced drop counter: a ``C`` record named
+        :data:`DROPPED_EVENT_NAME` appended to both export formats when the
+        ring evicted anything — a truncated trace must say so in-band, not
+        only in a log line that scrolled away."""
+        if not self.dropped:
+            return None
+        return {"name": self.DROPPED_EVENT_NAME, "ph": "C",
+                "ts": self._us(self._clock()), "tid": 0,
+                "args": {"value": float(self.dropped),
+                         "max_events": self._max_events}}
+
     def thread_names(self) -> dict[int, str]:
         with self._lock:
             return dict(self._thread_names)
@@ -203,8 +221,12 @@ class Tracer:
         """One event per line, same records as the Chrome export."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
+        recs = self.events()
+        dropped = self._dropped_record()
+        if dropped is not None:
+            recs.append(dropped)
         with open(path, "w") as f:
-            for rec in self.events():
+            for rec in recs:
                 f.write(json.dumps({"pid": self.PID, **rec}) + "\n")
         return path
 
@@ -221,12 +243,18 @@ class Tracer:
         for tid, tname in sorted(self.thread_names().items()):
             meta.append({"name": "thread_name", "ph": "M", "pid": self.PID,
                          "tid": tid, "args": {"name": tname}})
+        recs = self.events()
+        dropped = self._dropped_record()
+        if dropped is not None:
+            recs.append(dropped)
         payload = {
             "traceEvents": meta + [
-                {"pid": self.PID, **rec} for rec in self.events()
+                {"pid": self.PID, **rec} for rec in recs
             ],
             "displayTimeUnit": "ms",
         }
+        if self.dropped:
+            payload["droppedEvents"] = self.dropped
         with open(path, "w") as f:
             json.dump(payload, f)
         return path
@@ -344,8 +372,10 @@ class trace_to:
                      self.chrome_path)
         if self.tracer.dropped:
             logging.warning(
-                "trace truncated: %d events dropped past the %d-event cap "
-                "(Tracer(max_events=...) raises it)",
+                "trace ring wrapped: %d oldest events evicted past the "
+                "%d-event cap (Tracer(max_events=...) raises it; the "
+                "exports carry a %s counter record)",
                 self.tracer.dropped, self.tracer._max_events,
+                Tracer.DROPPED_EVENT_NAME,
             )
         return False

@@ -1,0 +1,467 @@
+"""Fleet telemetry plane (docs/OBSERVABILITY.md "Fleet telemetry"):
+MetricRegistry/Histogram semantics, the FleetHealth per-rank view, the
+tracker -> fleet transition timeline an operator actually sees (the PR 8
+tests drive the tracker directly; these assert the operator view), the
+report renderer's schema guard, and the end-to-end acceptance arm — a
+fault-injected buffered-async loopback run whose rendered fleet report
+surfaces the injected behavior: retries on the faulted rank, a
+non-degenerate staleness histogram, and the SLOW -> OFFLINE -> READMITTED
+timeline of a blackout worker.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+
+from fedml_tpu.algorithms.fedavg_distributed import run_distributed_fedavg
+from fedml_tpu.comm.faults import FaultSpec
+from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackFabric
+from fedml_tpu.comm.retry import RetryPolicy
+from fedml_tpu.comm.status import ClientStatus, ClientStatusTracker
+from fedml_tpu.core.trainer import ClientTrainer
+from fedml_tpu.data.synthetic import gaussian_blobs
+from fedml_tpu.models.linear import LogisticRegression
+from fedml_tpu.obs import registry
+from fedml_tpu.obs.registry import (
+    STATE_READMITTED,
+    FleetHealth,
+    Histogram,
+    MetricRegistry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_registry():
+    registry.uninstall()
+    yield
+    registry.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# Histogram: log-bucketing, merge, percentiles
+# ---------------------------------------------------------------------------
+
+
+def test_histogram_buckets_are_log_spaced_with_exact_power_boundaries():
+    h = Histogram(growth=2.0)
+    for v in (0.75, 1.0, 1.5, 2.0, 3.0, 4.0, 100.0):
+        h.observe(v)
+    # bucket i holds (2**(i-1), 2**i]: exact powers land in their own bucket
+    assert h.buckets == {0: 2, 1: 2, 2: 2, 7: 1}
+    assert h.count == 7 and h.min == 0.75 and h.max == 100.0
+    assert h.zeros == 0
+    assert h.bound(2) == 4.0
+
+
+def test_histogram_zero_and_negative_values_hit_the_zeros_bucket():
+    h = Histogram()
+    h.observe(0.0)
+    h.observe(-3.0)
+    h.observe(5.0)
+    assert h.zeros == 2 and sum(h.buckets.values()) == 1
+    assert h.count == 3 and h.min == -3.0
+
+
+def test_histogram_merge_and_snapshot_roundtrip():
+    a, b = Histogram(), Histogram()
+    for v in (1.0, 8.0):
+        a.observe(v)
+    for v in (0.0, 2.0, 64.0):
+        b.observe(v)
+    a.merge(b.snapshot())
+    assert a.count == 5 and a.zeros == 1
+    assert a.min == 0.0 and a.max == 64.0
+    rt = Histogram.from_snapshot(a.snapshot())
+    assert rt.snapshot() == a.snapshot()
+    with pytest.raises(ValueError, match="growth"):
+        a.merge(Histogram(growth=10.0).snapshot())
+
+
+def test_histogram_percentile_is_bucket_bound_clamped_to_observed_range():
+    h = Histogram()
+    for v in [3.0] * 99 + [1000.0]:
+        h.observe(v)
+    # p50 crosses in bucket (2,4] -> bound 4, inside the observed range
+    assert h.percentile(0.5) == 4.0
+    assert h.percentile(1.0) == 1000.0
+    z = Histogram()
+    z.observe(0.0)
+    z.observe(0.0)
+    assert z.percentile(0.9) == 0.0
+    assert Histogram().percentile(0.5) is None
+    assert Histogram().mean() is None
+
+
+# ---------------------------------------------------------------------------
+# MetricRegistry: atomic snapshot/merge + install/no-op discipline
+# ---------------------------------------------------------------------------
+
+
+def test_registry_snapshot_and_merge_semantics():
+    r = MetricRegistry()
+    r.counter("sends", 2)
+    r.counter("sends")
+    r.gauge("depth", 5)
+    r.observe("lat_ms", 3.0)
+    snap = r.snapshot()
+    assert snap["counters"] == {"sends": 3}
+    assert snap["gauges"] == {"depth": 5}
+    assert snap["histograms"]["lat_ms"]["count"] == 1
+    other = MetricRegistry()
+    other.counter("sends", 10)
+    other.gauge("depth", 7)
+    other.observe("lat_ms", 9.0)
+    r.merge(other.snapshot())
+    snap2 = r.snapshot()
+    # counters add, gauges last-wins, histograms merge
+    assert snap2["counters"] == {"sends": 13}
+    assert snap2["gauges"] == {"depth": 7}
+    assert snap2["histograms"]["lat_ms"]["count"] == 2
+    assert r.histogram("lat_ms").count == 2
+    assert r.histogram("nope") is None
+    r.clear()
+    assert r.snapshot() == {"counters": {}, "gauges": {}, "histograms": {}}
+
+
+def test_module_helpers_are_noops_until_installed():
+    assert registry.get() is None and not registry.enabled()
+    # no registry: these must be free no-ops, not errors
+    registry.counter("x")
+    registry.gauge("y", 1)
+    registry.observe("z", 2.0)
+    reg = registry.install()
+    assert registry.get() is reg and registry.enabled()
+    registry.counter("x")
+    registry.observe("z", 2.0)
+    assert reg.snapshot()["counters"] == {"x": 1}
+    assert registry.uninstall() is reg
+    assert registry.get() is None
+
+
+def test_registry_is_thread_safe_under_concurrent_recording():
+    r = MetricRegistry()
+    n, per = 8, 500
+
+    def hammer(i):
+        for k in range(per):
+            r.counter("total")
+            r.observe("v", float(k % 7))
+            r.gauge(f"g{i}", k)
+
+    threads = [threading.Thread(target=hammer, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = r.snapshot()
+    assert snap["counters"]["total"] == n * per
+    assert snap["histograms"]["v"]["count"] == n * per
+
+
+# ---------------------------------------------------------------------------
+# FleetHealth: per-rank records, timeline semantics, piggyback merge
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_timeline_dedupes_and_bounds():
+    f = FleetHealth()
+    f.record_state(1, ClientStatus.ONLINE)
+    f.record_state(1, ClientStatus.ONLINE)  # heartbeat re-assert: no growth
+    f.record_state(1, ClientStatus.SLOW)
+    f.record_state(1, ClientStatus.ONLINE)
+    assert [s for _, s in f.timeline(1)] == ["ONLINE", "SLOW", "ONLINE"]
+    assert f.state(1) == "ONLINE"
+    assert f.state(9) is None and f.timeline(9) == []
+    # the ring: oldest entries drop, the drop count is surfaced
+    f2 = FleetHealth()
+    states = [ClientStatus.ONLINE, ClientStatus.SLOW]
+    for i in range(FleetHealth.MAX_TIMELINE + 10):
+        f2.record_state(3, states[i % 2])
+    snap = f2.snapshot()["ranks"]["3"]
+    assert len(snap["timeline"]) == FleetHealth.MAX_TIMELINE
+    assert snap["timeline_dropped"] == 10
+
+
+def test_fleet_merge_report_field_semantics():
+    f = FleetHealth()
+    t0 = 1000.0
+    f.merge_report(2, {"sent_at": t0 - 0.050, "step_ms": 12.0,
+                       "retries": 3, "counts": {"folds_total": 7}}, now=t0)
+    f.merge_report(2, {"retries": 5}, now=t0)  # cumulative: last wins
+    f.merge_report(2, None)     # absent report: free no-op
+    f.merge_report(2, {})       # empty report: free no-op
+    rec = f.snapshot()["ranks"]["2"]
+    assert rec["gauges"]["retries"] == 5.0
+    assert rec["gauges"]["folds_total"] == 7.0
+    up = rec["histograms"]["upload_ms"]
+    assert up["count"] == 1 and abs(up["sum"] - 50.0) < 1.0
+    assert rec["histograms"]["step_ms"]["count"] == 1
+    # a skewed sender clock must not record negative latency
+    f.merge_report(4, {"sent_at": t0 + 99.0}, now=t0)
+    assert f.snapshot()["ranks"]["4"]["histograms"]["upload_ms"]["min"] == 0.0
+
+
+def test_fleet_snapshot_is_jsonable_and_round_record_stamps():
+    f = FleetHealth()
+    f.counter(1, "uploads")
+    f.observe(1, "staleness", 0)
+    f.observe(3, "staleness", 4)
+    f.record_state(3, ClientStatus.OFFLINE)
+    rec = f.round_record(7, extra={"mode": "async"})
+    parsed = json.loads(json.dumps(rec))
+    assert parsed["round"] == 7 and parsed["mode"] == "async"
+    assert set(parsed["ranks"]) == {"1", "3"}
+    merged = f.merged_histogram("staleness")
+    assert merged.count == 2 and merged.max == 4
+    assert f.merged_histogram("nope") is None
+    assert f.ranks() == [1, 3]
+
+
+# ---------------------------------------------------------------------------
+# tracker -> fleet: the operator-visible transition timeline (PR 8's tests
+# drive the tracker; this asserts what the fleet view shows for the same
+# heartbeat -> SLOW -> OFFLINE -> readmitted march)
+# ---------------------------------------------------------------------------
+
+
+def test_tracker_transitions_land_on_the_fleet_timeline():
+    tracker = ClientStatusTracker(2)
+    fleet = FleetHealth()
+    tracker.on_transition = fleet.record_state
+
+    tracker.update(1, ClientStatus.ONLINE)
+    for _ in range(5):  # heartbeats re-asserting ONLINE: liveness, no spam
+        tracker.update(1, ClientStatus.ONLINE)
+    tracker.update(1, ClientStatus.SLOW, touch=False)    # missed a deadline
+    tracker.update(1, ClientStatus.ONLINE)               # contact again
+    tracker.update(1, ClientStatus.OFFLINE, touch=False)  # excluded
+    # the server's readmission branch records the distinct returnee event
+    # BEFORE flipping the tracker back (fedavg_distributed._done)
+    fleet.record_state(1, STATE_READMITTED)
+    fleet.counter(1, "readmissions")
+    tracker.update(1, ClientStatus.ONLINE, touch=False)
+
+    assert [s for _, s in fleet.timeline(1)] == [
+        "ONLINE", "SLOW", "ONLINE", "OFFLINE", "READMITTED", "ONLINE",
+    ]
+    # ... and the timeline renders through the report
+    from tools.fleet_report import format_text, summarize
+
+    text = format_text(summarize(fleet.snapshot()))
+    assert "READMITTED" in text and "rank 1:" in text
+    ts = [t for t, _ in fleet.timeline(1)]
+    assert ts == sorted(ts)
+
+
+def test_slow_and_offline_marks_never_count_as_contact():
+    tracker = ClientStatusTracker(1)
+    fleet = FleetHealth()
+    tracker.on_transition = fleet.record_state
+    tracker.update(1, ClientStatus.ONLINE)
+    seen = tracker.last_seen(1)
+    time.sleep(0.01)
+    tracker.update(1, ClientStatus.SLOW, touch=False)
+    tracker.update(1, ClientStatus.OFFLINE, touch=False)
+    assert tracker.last_seen(1) == seen  # only real contact touches
+    assert fleet.state(1) == ClientStatus.OFFLINE
+
+
+# ---------------------------------------------------------------------------
+# report renderer: schema guard + rendering
+# ---------------------------------------------------------------------------
+
+
+def test_report_validate_names_the_defect():
+    from tools.fleet_report import validate_record
+
+    with pytest.raises(ValueError, match="ranks"):
+        validate_record({"round": 1})
+    with pytest.raises(ValueError, match="missing"):
+        validate_record({"ranks": {"1": {"state": None}}})
+    f = FleetHealth()
+    f.counter(1, "uploads")
+    bad = f.round_record(0)
+    bad["ranks"]["1"]["histograms"]["x"] = {"count": 1}  # truncated snapshot
+    with pytest.raises(ValueError, match="histogram"):
+        validate_record(bad)
+    assert validate_record(f.round_record(1))["round"] == 1
+
+
+def test_report_renders_table_histograms_and_timeline():
+    from tools.fleet_report import format_text, summarize
+
+    f = FleetHealth()
+    for rank, stale in ((1, 0), (2, 3)):
+        f.counter(rank, "uploads", 4)
+        f.observe(rank, "staleness", stale)
+        f.observe(rank, "step_ms", 10.0 * (rank + 1))
+        f.gauge(rank, "retries", rank - 1)
+        f.record_state(rank, ClientStatus.ONLINE)
+    report = summarize(f.snapshot(), rounds=4)
+    assert [r["rank"] for r in report["per_rank"]] == [1, 2]
+    assert report["per_rank"][1]["retries"] == 1
+    assert report["histograms"]["staleness"]["count"] == 2
+    text = format_text(report)
+    assert "staleness" in text and "step_ms" in text and "rank 2" in text
+
+
+def test_report_loads_jsonl_and_totals_files(tmp_path):
+    from tools.fleet_report import load_fleet
+
+    f = FleetHealth()
+    f.counter(1, "uploads")
+    jsonl = tmp_path / "fleet.jsonl"
+    with open(jsonl, "w") as fh:
+        for r in range(3):
+            f.counter(1, "uploads")
+            fh.write(json.dumps(f.round_record(r)) + "\n")
+    view, rounds = load_fleet(jsonl)
+    assert rounds == 3
+    assert view["ranks"]["1"]["counters"]["uploads"] == 4  # cumulative last
+    total = tmp_path / "fleet.json"
+    total.write_text(json.dumps({"totals": f.snapshot(), "rounds": [1, 2]}))
+    view2, rounds2 = load_fleet(total)
+    assert rounds2 == 2 and view2["ranks"]["1"]["counters"]["uploads"] == 4
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance arm: a fault-injected async run's report surfaces
+# the injected behavior (retries, staleness, blackout timeline)
+# ---------------------------------------------------------------------------
+
+
+class _BlackoutComm(LoopbackCommManager):
+    """Client transport that silently swallows every send while the event
+    is set — the worker looks dead on both planes (uploads + heartbeats)."""
+
+    def __init__(self, fabric, rank, blackout: threading.Event):
+        super().__init__(fabric, rank)
+        self.blackout = blackout
+
+    def send_message(self, msg):
+        if self.blackout.is_set():
+            return
+        super().send_message(msg)
+
+
+def test_faulted_async_run_report_surfaces_injected_behavior():
+    """The acceptance arm: buffered-async loopback run with (a) seeded
+    transient send failures on rank 1 recovered by retries, (b)
+    buffer_goal < live workers so late folds land stale, (c) a blackout
+    worker (rank 4) dark from the start, revived once the fleet view marks
+    it OFFLINE. The rendered fleet report must surface all three."""
+    import fedml_tpu.async_agg.server as asrv
+
+    workers, versions = 4, 28
+    hb_interval = 0.1  # => heartbeat_timeout 0.3, fleet OFFLINE at 0.9
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=24,
+                              num_classes=4, seed=3)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.2), epochs=1)
+    # pre-compile the client program so the paced cadence starts immediately
+    # (same rationale as test_ft_runtime._warm_jit)
+    from tests.test_ft_runtime import _warm_jit
+
+    _warm_jit(trainer, train)
+
+    fabric = LoopbackFabric(workers + 1)
+    blackout = threading.Event()
+    blackout.set()  # rank 4 starts dark
+    holder: dict = {}
+
+    orig = asrv.AsyncFedAvgServerManager
+
+    class CapturingAsyncServer(orig):
+        def __init__(self, *a, **kw):
+            super().__init__(*a, **kw)
+            holder["server"] = self
+
+    def make_comm(rank):
+        if rank == 4:
+            return _BlackoutComm(fabric, rank, blackout)
+        return LoopbackCommManager(fabric, rank)
+
+    def watcher():
+        # revive the worker once the operator view writes it off — its
+        # heartbeats then resume and the next sweep readmits it
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            server = holder.get("server")
+            if (server is not None and server.fleet is not None
+                    and server.fleet.state(4) == ClientStatus.OFFLINE):
+                blackout.clear()
+                return
+            time.sleep(0.02)
+
+    w = threading.Thread(target=watcher, daemon=True)
+    w.start()
+    fleet_stats: dict = {}
+    asrv.AsyncFedAvgServerManager = CapturingAsyncServer
+    try:
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=workers, round_num=versions,
+            batch_size=8, make_comm=make_comm,
+            server_mode="async", buffer_goal=2, staleness_weight="const",
+            # delay paces the live ranks (~0.12 s/upload) so heartbeat ages
+            # span the SLOW/OFFLINE thresholds; fail=0.5 on rank 1 is the
+            # retry-recovered fault
+            fault_specs={1: FaultSpec(delay=0.12, fail=0.5),
+                         2: FaultSpec(delay=0.12),
+                         3: FaultSpec(delay=0.12)},
+            fault_seed=13,
+            retry_policy=RetryPolicy(max_attempts=10, base_delay=0.002,
+                                     jitter=0.0),
+            heartbeat_interval=hb_interval,
+            fleet_stats=fleet_stats,
+        )
+    finally:
+        asrv.AsyncFedAvgServerManager = orig
+    w.join(timeout=5)
+    for leaf in jax.tree.leaves(final):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+    from tools.fleet_report import format_text, summarize, validate_record
+
+    totals = validate_record(fleet_stats["totals"])
+    report = summarize(totals, len(fleet_stats.get("rounds", [])))
+    by_rank = {r["rank"]: r for r in report["per_rank"]}
+
+    # (a) the faulted rank's recovered retries surface per-rank
+    assert by_rank[1]["retries"] > 0, by_rank[1]
+    assert by_rank[2]["retries"] == by_rank[3]["retries"] == 0, by_rank
+    # (b) buffer_goal < live workers: the staleness histogram carries both
+    # fresh and stale mass
+    hist = report["histograms"]["staleness"]
+    assert hist["zeros"] > 0 and sum(hist["buckets"].values()) > 0, hist
+    # (c) the blackout worker's operator timeline: written off, revived,
+    # readmitted — in order
+    states = [s for _, s in totals["ranks"]["4"]["timeline"]]
+    for a, b in (("SLOW", "OFFLINE"), ("OFFLINE", "READMITTED"),
+                 ("READMITTED", "ONLINE")):
+        assert a in states and b in states, (states, a, b)
+        assert states.index(a) < states.index(b), states
+    assert by_rank[4]["state"] == "ONLINE", by_rank[4]
+    text = format_text(report)
+    assert "READMITTED" in text and "rank 4:" in text
+
+
+# ---------------------------------------------------------------------------
+# the tier-1 smoke tool runs in-process (mirrors the wire/ft/async smokes)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_smoke_tool_runs():
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "fleet_smoke.py"
+    spec = importlib.util.spec_from_file_location("fleet_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

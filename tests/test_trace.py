@@ -85,13 +85,31 @@ def test_disabled_tracer_is_shared_noop():
     assert trace.span("again") is s1
 
 
-def test_event_cap_truncates_not_grows():
+def test_event_cap_is_a_ring_keeping_the_recent_window(tmp_path):
     t = Tracer(max_events=3)
     for i in range(5):
         t.event(f"e{i}")
+    # ring semantics: bounded memory, OLDEST evicted — a multi-hour traced
+    # run keeps the most recent window, the part an operator debugging a
+    # live slowdown actually wants
     assert len(t.events()) == 3
     assert t.dropped == 2
-    assert [e["name"] for e in t.events()] == ["e0", "e1", "e2"]
+    assert [e["name"] for e in t.events()] == ["e2", "e3", "e4"]
+    # both exports surface the drop count in-band
+    jl = t.export_jsonl(tmp_path / "t.jsonl")
+    lines = [json.loads(line) for line in jl.read_text().splitlines()]
+    assert lines[-1]["name"] == Tracer.DROPPED_EVENT_NAME
+    assert lines[-1]["args"]["value"] == 2.0
+    raw = json.loads(t.export_chrome(tmp_path / "t.json").read_text())
+    assert raw["droppedEvents"] == 2
+    assert any(e["name"] == Tracer.DROPPED_EVENT_NAME
+               for e in raw["traceEvents"])
+    # an un-wrapped tracer exports no drop record
+    t2 = Tracer(max_events=10)
+    t2.event("only")
+    jl2 = t2.export_jsonl(tmp_path / "t2.jsonl")
+    assert all(json.loads(line)["name"] != Tracer.DROPPED_EVENT_NAME
+               for line in jl2.read_text().splitlines())
 
 
 def test_install_returns_and_replaces():

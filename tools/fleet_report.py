@@ -1,0 +1,232 @@
+"""Render a fleet telemetry view (obs/registry.py FleetHealth snapshots,
+written as fleet.jsonl by ``main_fedavg --fleet_stats``): per-rank health
+table, fleet-wide latency/staleness histograms, and each rank's
+health-state timeline — the terminal-side answer to "which clients are
+slow, how stale is the fold, who went dark and when".
+
+    python tools/fleet_report.py RUN_DIR/fleet.jsonl
+    python tools/fleet_report.py RUN_DIR/fleet.json --format json
+
+Accepts the per-round JSONL (each line a cumulative fleet snapshot stamped
+with its round; the LAST line is the run's final view), a ``fleet.json``
+totals file, or a bare FleetHealth snapshot. See docs/OBSERVABILITY.md
+"Fleet telemetry" for the record schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the three fleet-wide distributions the report renders (every other
+# histogram a rank carries still lands in the per-rank JSON report)
+FLEET_HISTOGRAMS = ("step_ms", "upload_ms", "staleness")
+BAR_WIDTH = 40
+
+_RANK_KEYS = ("state", "timeline", "timeline_dropped", "counters", "gauges",
+              "histograms")
+_HIST_KEYS = ("count", "sum", "growth", "zeros", "buckets")
+
+
+def validate_record(rec: dict) -> dict:
+    """Schema-check one fleet record (a round_record line or a bare
+    snapshot) and return it. Raises ValueError naming the defect — the
+    smoke's guard that the wire/JSONL format stays renderable."""
+    if not isinstance(rec, dict) or "ranks" not in rec:
+        got = sorted(rec) if isinstance(rec, dict) else type(rec).__name__
+        raise ValueError(f"fleet record has no 'ranks' key: {got}")
+    if not isinstance(rec["ranks"], dict):
+        raise ValueError("fleet record 'ranks' is not a dict")
+    for rank, rr in rec["ranks"].items():
+        missing = [k for k in _RANK_KEYS if k not in rr]
+        if missing:
+            raise ValueError(f"rank {rank} record missing {missing}")
+        for entry in rr["timeline"]:
+            if len(entry) != 2:
+                raise ValueError(
+                    f"rank {rank} timeline entry {entry!r} is not "
+                    "(t_seconds, state)")
+        for name, h in rr["histograms"].items():
+            hmissing = [k for k in _HIST_KEYS if k not in h]
+            if hmissing:
+                raise ValueError(
+                    f"rank {rank} histogram {name!r} missing {hmissing}")
+    return rec
+
+
+def load_fleet(path: str | Path) -> tuple[dict, int]:
+    """Load a fleet view: returns ``(snapshot, rounds)`` where ``snapshot``
+    is the cumulative final view and ``rounds`` the number of per-round
+    records the file carried (0 for a bare totals file)."""
+    path = Path(path)
+    text = path.read_text()
+    try:
+        obj = json.loads(text)
+    except json.JSONDecodeError:
+        recs = [json.loads(line) for line in text.splitlines() if line.strip()]
+        if not recs:
+            raise ValueError(f"{path}: empty fleet file")
+        for rec in recs:
+            validate_record(rec)
+        return recs[-1], len(recs)
+    if isinstance(obj, dict) and "totals" in obj:  # fleet.json shape
+        rounds = obj.get("rounds_recorded", len(obj.get("rounds", [])))
+        return validate_record(obj["totals"]), int(rounds)
+    return validate_record(obj), 1 if obj.get("round") is not None else 0
+
+
+def _hist(snap: dict | None):
+    from fedml_tpu.obs.registry import Histogram
+
+    return Histogram.from_snapshot(snap) if snap else None
+
+
+def _pct(h, q: float):
+    v = h.percentile(q) if h is not None else None
+    return None if v is None else round(v, 3)
+
+
+def summarize(view: dict, rounds: int = 0) -> dict:
+    """Aggregate one fleet snapshot into the report dict: per-rank rows,
+    fleet-wide merged histograms, and per-rank state timelines."""
+    from fedml_tpu.obs.registry import Histogram
+
+    ranks = view.get("ranks", {})
+    rows = []
+    merged: dict[str, Histogram | None] = {n: None for n in FLEET_HISTOGRAMS}
+    timelines = {}
+    for rank in sorted(ranks, key=int):
+        rr = ranks[rank]
+        hists = {n: _hist(rr["histograms"].get(n)) for n in FLEET_HISTOGRAMS}
+        for n, h in hists.items():
+            if h is None:
+                continue
+            if merged[n] is None:
+                merged[n] = Histogram(growth=h.growth)
+            merged[n].merge(h.snapshot())
+        c, g = rr["counters"], rr["gauges"]
+        stale_h = hists["staleness"]
+        rows.append({
+            "rank": int(rank),
+            "state": rr["state"],
+            "uploads": int(c.get("uploads", 0)),
+            # sync discards stale uploads; async folds them down-weighted —
+            # one column answers "how often was this rank behind"
+            "stale": int(c.get("stale_uploads", 0) + c.get("stale_folds", 0)),
+            "dup": int(c.get("dup_uploads", 0)),
+            "retries": int(g.get("retries", 0)),
+            "readmissions": int(c.get("readmissions", 0)),
+            "step_ms_p50": _pct(hists["step_ms"], 0.5),
+            "step_ms_p99": _pct(hists["step_ms"], 0.99),
+            "upload_ms_p50": _pct(hists["upload_ms"], 0.5),
+            "upload_ms_p99": _pct(hists["upload_ms"], 0.99),
+            "staleness_mean": (None if stale_h is None or not stale_h.count
+                               else round(stale_h.mean(), 3)),
+            "staleness_max": (None if stale_h is None else stale_h.max),
+            "heartbeat_age_s": g.get("heartbeat_age_s"),
+            "gauges": dict(g),
+            # every histogram the rank carries, not just the three fleet-
+            # wide ones (a tree root's per-tier "folds" distribution lives
+            # here) — the text table stays columnar, --format json gets all
+            "histograms": {k: dict(h) for k, h in rr["histograms"].items()},
+            "timeline_dropped": int(rr.get("timeline_dropped", 0)),
+        })
+        if rr["timeline"]:
+            timelines[int(rank)] = [list(e) for e in rr["timeline"]]
+    return {
+        "rounds": rounds,
+        "ranks": len(rows),
+        "per_rank": rows,
+        "histograms": {n: (h.snapshot() if h is not None else None)
+                       for n, h in merged.items()},
+        "timelines": timelines,
+    }
+
+
+def _fmt_bucket_rows(snap: dict) -> list[tuple[str, int]]:
+    rows = []
+    if snap.get("zeros"):
+        rows.append(("0", int(snap["zeros"])))
+    growth = float(snap.get("growth", 2.0))
+    for idx, n in sorted(snap.get("buckets", {}).items(), key=lambda kv: int(kv[0])):
+        bound = growth ** int(idx)
+        label = f"<= {bound:g}"
+        rows.append((label, int(n)))
+    return rows
+
+
+def _render_histogram(name: str, snap: dict | None) -> list[str]:
+    if not snap or not snap.get("count"):
+        return []
+    lines = [
+        "",
+        f"{name}: {snap['count']} samples, min {snap['min']:g}, "
+        f"max {snap['max']:g}, mean {snap['sum'] / snap['count']:g}",
+    ]
+    rows = _fmt_bucket_rows(snap)
+    peak = max(n for _, n in rows)
+    for label, n in rows:
+        bar = "#" * max(1, round(BAR_WIDTH * n / peak))
+        lines.append(f"  {label:>12} {n:>8} {bar}")
+    return lines
+
+
+def _na(v, fmt="{}"):
+    return "-" if v is None else fmt.format(v)
+
+
+def format_text(report: dict) -> str:
+    lines = [
+        f"fleet: {report['ranks']} ranks over {report['rounds']} recorded "
+        "rounds",
+        "",
+        f"{'rank':>4} {'state':<10} {'uploads':>7} {'stale':>5} {'dup':>4} "
+        f"{'retry':>5} {'step p50':>9} {'p99':>9} {'upld p50':>9} {'p99':>9} "
+        f"{'stal mean':>9} {'max':>5}",
+    ]
+    for r in report["per_rank"]:
+        lines.append(
+            f"{r['rank']:>4} {_na(r['state']):<10} {r['uploads']:>7} "
+            f"{r['stale']:>5} {r['dup']:>4} {r['retries']:>5} "
+            f"{_na(r['step_ms_p50']):>9} {_na(r['step_ms_p99']):>9} "
+            f"{_na(r['upload_ms_p50']):>9} {_na(r['upload_ms_p99']):>9} "
+            f"{_na(r['staleness_mean']):>9} {_na(r['staleness_max'], '{:g}'):>5}"
+        )
+    for name in FLEET_HISTOGRAMS:
+        lines += _render_histogram(name, report["histograms"].get(name))
+    if report["timelines"]:
+        lines += ["", "health-state timelines (t seconds from server start):"]
+        for rank in sorted(report["timelines"]):
+            steps = " -> ".join(
+                f"{state}@{t:g}" for t, state in report["timelines"][rank]
+            )
+            lines.append(f"  rank {rank}: {steps}")
+    dropped = sum(r["timeline_dropped"] for r in report["per_rank"])
+    if dropped:
+        lines.append(f"  ({dropped} oldest timeline entries dropped past the "
+                     "per-rank ring)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fedml_tpu fleet telemetry report")
+    p.add_argument("fleet", help="fleet.jsonl (per-round snapshots) or "
+                                 "fleet.json totals from --fleet_stats")
+    p.add_argument("--format", choices=["text", "json"], default="text")
+    args = p.parse_args(argv)
+    view, rounds = load_fleet(args.fleet)
+    report = summarize(view, rounds)
+    if args.format == "json":
+        print(json.dumps(report))
+    else:
+        print(format_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -120,15 +120,21 @@ def summarize(events: list[dict]) -> dict:
     stall_us = sum(
         s.get("dur", 0.0) for s in spans if s["name"] in STALL_SPANS
     )
+    # counter/gauge series rollup: sample count + min/max/mean/last — the
+    # series' shape without replaying it (a gauge's min/max bound its
+    # excursion; a cumulative counter's last value is its total)
     counter_rollup: dict[str, dict] = {}
     for c in counters:
         v = c.get("args", {}).get("value")
         r = counter_rollup.setdefault(
-            c["name"], {"count": 0, "last": None, "mean": 0.0})
+            c["name"],
+            {"count": 0, "last": None, "mean": 0.0, "min": None, "max": None})
         r["count"] += 1
         r["last"] = v
         if v is not None:
             r["mean"] += (v - r["mean"]) / r["count"]
+            r["min"] = v if r["min"] is None else min(r["min"], v)
+            r["max"] = v if r["max"] is None else max(r["max"], v)
     for r in counter_rollup.values():
         r["mean"] = round(r["mean"], 4)
     occ = counter_rollup.get(OCCUPANCY_GAUGE)
@@ -158,11 +164,13 @@ def format_text(report: dict, top: int) -> str:
             f"{r['self_ms']:>10.2f} {r['max_ms']:>9.2f}"
         )
     if report["counters"]:
-        lines += ["", f"{'counter':<34} {'samples':>7} {'mean':>10} {'last':>10}"]
+        lines += ["", f"{'counter':<34} {'samples':>7} {'min':>10} "
+                      f"{'max':>10} {'mean':>10} {'last':>10}"]
         for name in sorted(report["counters"]):
             c = report["counters"][name]
             lines.append(
-                f"{name:<34} {c['count']:>7} {c['mean']:>10} {c['last']:>10}"
+                f"{name:<34} {c['count']:>7} {c.get('min'):>10} "
+                f"{c.get('max'):>10} {c['mean']:>10} {c['last']:>10}"
             )
     if report["instants"]:
         lines += ["", "markers: " + ", ".join(report["instants"])]
