@@ -44,10 +44,10 @@ class MyMessage:
     MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
 
     MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
-    MSG_ARG_KEY_MODEL_DESC = "model_desc"
+    MSG_ARG_KEY_MODEL_DESC = Message.MSG_ARG_KEY_MODEL_DESC
     MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
     MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
-    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+    MSG_ARG_KEY_ROUND_IDX = Message.MSG_ARG_KEY_ROUND_IDX
 
 
 class EmptyRoundError(RuntimeError):
@@ -82,12 +82,13 @@ class FedAvgDistAggregator:
 
     def __init__(self, worker_num: int):
         self.worker_num = worker_num
-        self.sample_num_dict: dict[int, float] = {}
-        self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}
+        self.sample_num_dict: dict[int, float] = {}  # guarded-by: _lock
+        self.flag_client_model_uploaded_dict = {i: False for i in range(worker_num)}  # guarded-by: _lock
         self._lock = threading.Lock()  # reference hazard fixed (SURVEY §5.2)
-        self._acc: np.ndarray | None = None
-        self._wsum = 0.0
-        self._excluded: list[int] = []  # workers dropped via exclude_worker
+        self._acc: np.ndarray | None = None  # guarded-by: _lock
+        self._wsum = 0.0  # guarded-by: _lock
+        # workers dropped via exclude_worker
+        self._excluded: list[int] = []  # guarded-by: _lock
 
     def exclude_worker(self, index: int) -> None:
         """Stop expecting this worker (marked OFFLINE): later rounds
@@ -123,7 +124,7 @@ class FedAvgDistAggregator:
         with self._lock:
             return sorted(self._excluded)
 
-    def _empty_round_error(self) -> "EmptyRoundError":
+    def _empty_round_error(self) -> "EmptyRoundError":  # lock-held: _lock
         """Diagnosable all-dropped-round error naming WHICH ranks were
         missing and which were already OFFLINE-excluded (caller holds the
         lock) — an all-dropped round must be debuggable from the log
@@ -191,7 +192,7 @@ class FedAvgDistAggregator:
         with self._lock:
             return index in self.flag_client_model_uploaded_dict
 
-    def _fold(self, payload, sample_num: float) -> None:
+    def _fold(self, payload, sample_num: float) -> None:  # lock-held: _lock
         """Fold one upload into the running tally (caller holds the lock).
         Payloads are pack_pytree byte vectors; model leaves are float32
         (validated against the descriptor at server init), so the weighted
@@ -202,7 +203,7 @@ class FedAvgDistAggregator:
         self._acc += np.multiply(x, float(sample_num), dtype=np.float64)
         self._wsum += float(sample_num)
 
-    def _finish(self) -> np.ndarray:
+    def _finish(self) -> np.ndarray:  # lock-held: _lock
         """Close the tally (caller holds the lock): divide by the weight sum
         and return wire bytes."""
         out = (self._acc / self._wsum).astype(np.float32).view(np.uint8)
@@ -253,7 +254,8 @@ class BufferedFedAvgDistAggregator(FedAvgDistAggregator):
 
     def __init__(self, worker_num: int):
         super().__init__(worker_num)
-        self.model_dict: dict[int, np.ndarray] = {}  # insertion == arrival
+        # insertion == arrival
+        self.model_dict: dict[int, np.ndarray] = {}  # guarded-by: _lock
 
     def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
         with self._lock:
@@ -307,10 +309,6 @@ class FedAvgServerManager(ServerManager):
         # retain-then-sum shape — both kept as the A/B reference arms
         self.use_broadcast = bool(use_broadcast)
         self.buffered_aggregation = bool(buffered_aggregation)
-        self.aggregator = (
-            BufferedFedAvgDistAggregator if self.buffered_aggregation
-            else FedAvgDistAggregator
-        )(worker_num)
         self.global_flat = init_flat
         self.model_desc = model_desc
         # elastic rounds (SURVEY §5.4 failure handling): if set, a round
@@ -324,7 +322,7 @@ class FedAvgServerManager(ServerManager):
         # it from that round's aggregate); with readmission enabled an
         # excluded worker that re-contacts the server rejoins later cohorts
         self.exclude_after = exclude_after
-        self._miss_counts: dict[int, int] = {}
+        self._miss_counts: dict[int, int] = {}  # guarded-by: _round_lock
         # liveness plane (docs/ROBUSTNESS.md "Failure recovery"): a worker
         # missing at the round timeout but heard from (heartbeat/status)
         # within heartbeat_timeout seconds is SLOW — alive, dropped from
@@ -333,7 +331,7 @@ class FedAvgServerManager(ServerManager):
         # stop, and re-enters them into later cohorts on contact.
         self.heartbeat_timeout = heartbeat_timeout
         self.readmission = bool(readmission)
-        self._pending_readmit: set[int] = set()
+        self._pending_readmit: set[int] = set()  # guarded-by: _round_lock
         # crash recovery: a RoundCheckpointer (obs/checkpoint.py) given
         # here snapshots the full server round state every
         # checkpoint_every closes; restore_from_checkpoint() resumes
@@ -350,7 +348,7 @@ class FedAvgServerManager(ServerManager):
         self.fleet = fleet
         if fleet is not None:
             self.status.on_transition = fleet.record_state
-        self._round_timer: "threading.Timer | None" = None
+        self._round_timer: "threading.Timer | None" = None  # guarded-by: _round_lock
         self._round_lock = threading.Lock()
         import json
 
@@ -365,7 +363,24 @@ class FedAvgServerManager(ServerManager):
         # already-closed round) are discarded by the sync protocol — counted
         # here so the loss is visible (Comm/StaleUploads in comm_stats
         # totals; the async server folds them weighted instead)
-        self.stale_uploads = 0
+        self.stale_uploads = 0  # guarded-by: _round_lock
+        # the ONE aggregator construction (fedlint: overwrite-after-super;
+        # ROADMAP item 1's factory seam): subclasses override
+        # _make_aggregator and hoist whatever config it reads (codec,
+        # robust_config) ABOVE their super().__init__ call — the diamond
+        # composes by overriding the factory, never by reassigning the
+        # already-built tally
+        self.aggregator = self._make_aggregator()
+
+    def _make_aggregator(self):
+        """Build this server's round tally. Called exactly once, at the end
+        of the base ``__init__`` (after ``worker_num``/``model_desc``/
+        ``global_flat`` are set); every protocol variant overrides this
+        instead of construct-then-overwriting ``self.aggregator``."""
+        return (
+            BufferedFedAvgDistAggregator if self.buffered_aggregation
+            else FedAvgDistAggregator
+        )(self.worker_num)
 
     def _model_payload(self, rank: int):
         """Model payload for ``rank`` — the wire-format seam. Base sends the
@@ -430,7 +445,7 @@ class FedAvgServerManager(ServerManager):
                     msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                    self.model_desc)
                 if finished:
-                    msg.add_params("finished", 1)
+                    msg.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
                 try:
                     self.broadcast_message(msg, group,
                                            per_receiver=per_receiver)
@@ -450,7 +465,7 @@ class FedAvgServerManager(ServerManager):
                         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
                                        self.model_desc)
                     if finished:
-                        msg.add_params("finished", 1)
+                        msg.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
                     if per_receiver is not None:
                         for k, v in per_receiver[w].items():
                             msg.add_params(k, v)
@@ -723,7 +738,7 @@ class FedAvgServerManager(ServerManager):
 
     # -- crash recovery (docs/ROBUSTNESS.md "Failure recovery") --------------
 
-    def _checkpoint_state(self) -> dict | None:
+    def _checkpoint_state(self) -> dict | None:  # lock-held: _round_lock
         """Snapshot the full server round state at round close (caller
         holds ``_round_lock``) — everything a restarted server needs to
         re-broadcast ``round_idx`` and continue bit-identically: the new
@@ -733,8 +748,10 @@ class FedAvgServerManager(ServerManager):
         (:meth:`_write_checkpoint`) runs after it is released."""
         if self.checkpointer is None or (self.round_idx % self.checkpoint_every):
             return None
+        # "server_round", not the wire key's "round_idx" spelling: the
+        # checkpoint schema and the wire contract drift independently
         return {
-            "round_idx": int(self.round_idx),
+            "server_round": int(self.round_idx),
             "global_flat": np.asarray(self.global_flat),
             "miss_counts": {str(k): int(v)
                             for k, v in self._miss_counts.items()},
@@ -747,8 +764,8 @@ class FedAvgServerManager(ServerManager):
         round callback and the next fan-out, so a crash during either
         resumes from this round — and the authoritative-round-index sync
         makes the replayed fan-out idempotent."""
-        with trace.span("ft/checkpoint", round=state["round_idx"]):
-            self.checkpointer.save_server(state["round_idx"], state)
+        with trace.span("ft/checkpoint", round=state["server_round"]):
+            self.checkpointer.save_server(state["server_round"], state)
 
     def restore_from_checkpoint(self, checkpointer=None,
                                 round_idx: int | None = None) -> int:
@@ -763,7 +780,11 @@ class FedAvgServerManager(ServerManager):
             raise ValueError("restore_from_checkpoint needs a checkpointer")
         state = ckptr.restore_server(round_idx)
         with self._round_lock:
-            self.round_idx = int(state["round_idx"])
+            # pre-PR 11 snapshots spelled the scalar "round_idx"; accept
+            # both so a crash recovery spanning the rename still resumes
+            # fedlint: disable=wire-contract -- legacy checkpoint schema field, not the wire key
+            legacy = state.get("round_idx")
+            self.round_idx = int(state.get("server_round", legacy))
             self.global_flat = np.asarray(state["global_flat"], np.uint8)
             self._miss_counts = {
                 int(k): int(v)
@@ -833,7 +854,7 @@ class FedAvgClientManager(ClientManager):
                        self._encode_model(new_vars))
 
     def _on_sync(self, msg: Message) -> None:
-        if msg.get("finished"):
+        if msg.get(Message.MSG_ARG_KEY_FINISHED):
             self.finish()
             return
         # fleet telemetry (obs/registry.py, docs/OBSERVABILITY.md "Fleet
@@ -950,18 +971,23 @@ class CompressedFedAvgServerManager(FedAvgServerManager):
     EncodedUpdate planes up, with bytes-on-wire accounting per round."""
 
     def __init__(self, *args, codec=None, **kwargs):
-        super().__init__(*args, **kwargs)
         if codec is None:
             raise ValueError("CompressedFedAvgServerManager needs a codec")
+        # hoisted ABOVE super().__init__ so the base's single
+        # _make_aggregator() call sees it (the factory seam, ROADMAP item 1)
         self.codec = codec
-        self.aggregator = (
-            CompressedBufferedDistAggregator if self.buffered_aggregation
-            else CompressedDistAggregator
-        )(self.worker_num, codec)
-        self.aggregator.get_global = lambda: self.global_flat
+        super().__init__(*args, **kwargs)
         from fedml_tpu.obs.metrics import CommBytesAccountant
 
         self.accountant = CommBytesAccountant()
+
+    def _make_aggregator(self):
+        agg = (
+            CompressedBufferedDistAggregator if self.buffered_aggregation
+            else CompressedDistAggregator
+        )(self.worker_num, self.codec)
+        agg.get_global = lambda: self.global_flat
+        return agg
 
     def _model_payload(self, rank: int):
         flat = super()._model_payload(rank)

@@ -42,8 +42,8 @@ class GKTMsg:
     MSG_TYPE_C2S_FINAL_VARS = 5
 
     KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
-    KEY_DESC = "model_desc"
-    KEY_ROUND = "round_idx"
+    KEY_DESC = Message.MSG_ARG_KEY_MODEL_DESC
+    KEY_ROUND = Message.MSG_ARG_KEY_ROUND_IDX
     KEY_ROUND_KEY = "round_key"
     KEY_SERVER_LOGITS = "server_logits"
     KEY_FEATS = "extracted_features"

@@ -116,12 +116,12 @@ class RobustDistAggregator(FedAvgDistAggregator):
         self.config = config
         self.get_global = None  # wired by the server manager (current flat)
         self._norm_mask = flat_norm_mask(model_desc) if model_desc else None
-        self._round_counter = 0
-        self._reservoir: list[np.ndarray] = []
-        self._res_seen = 0
-        self._res_rng = _reservoir_rng(config, 0)
-        self._stats = {"norm_sum": 0.0, "n": 0, "clipped": 0, "rejected": 0}
-        self._last_record: dict | None = None
+        self._round_counter = 0  # guarded-by: _lock
+        self._reservoir: list[np.ndarray] = []  # guarded-by: _lock
+        self._res_seen = 0  # guarded-by: _lock
+        self._res_rng = _reservoir_rng(config, 0)  # guarded-by: _lock
+        self._stats = {"norm_sum": 0.0, "n": 0, "clipped": 0, "rejected": 0}  # guarded-by: _lock
+        self._last_record: dict | None = None  # guarded-by: _lock
 
     # -- defended arrival fold ----------------------------------------------
 
@@ -129,7 +129,7 @@ class RobustDistAggregator(FedAvgDistAggregator):
         x = np.ascontiguousarray(payload).view(np.float32)
         self._defended_fold(x, sample_num)
 
-    def _defended_fold(self, x: np.ndarray, sample_num: float) -> None:
+    def _defended_fold(self, x: np.ndarray, sample_num: float) -> None:  # lock-held: _lock
         """Clip ``x`` (a flat f32 model vector) against the last broadcast
         global and fold it — into the f64 accumulator (mean rule) and/or the
         reservoir (order-statistic rules). Caller holds the tally lock."""
@@ -162,7 +162,7 @@ class RobustDistAggregator(FedAvgDistAggregator):
             else:
                 self._reservoir_add(x)
 
-    def _reservoir_add(self, x: np.ndarray) -> None:
+    def _reservoir_add(self, x: np.ndarray) -> None:  # lock-held: _lock
         """Algorithm-R reservoir over the round's (clipped) uploads: every
         upload has equal probability K/seen of being in the close-time
         stack. ``reservoir_k == 0`` keeps everything (the exact rule)."""
@@ -232,7 +232,7 @@ class RobustDistAggregator(FedAvgDistAggregator):
             }
             return out.astype(np.float32).view(np.uint8)
 
-    def _combine_reservoir(self, stack: np.ndarray) -> tuple[np.ndarray, int]:
+    def _combine_reservoir(self, stack: np.ndarray) -> tuple[np.ndarray, int]:  # lock-held: _lock
         """Run the sim's rule functions — the single source of the combine
         arithmetic — over the reservoir stack. Returns (aggregate, number of
         updates the rule discarded).
@@ -286,23 +286,29 @@ class RobustDistAggregator(FedAvgDistAggregator):
         the server checkpoints; carried anyway). Called at round close
         under the server's round lock — no concurrent folds."""
         out = super().snapshot_state()
-        out["robust_round"] = int(self._round_counter)
-        out["res_seen"] = int(self._res_seen)
-        if self._reservoir:
-            out["reservoir"] = np.stack(self._reservoir)
+        # the base released _lock after its snapshot; re-acquire for the
+        # defense fields (fedlint guarded-by: a fold racing this snapshot
+        # must never read a half-written reservoir)
+        with self._lock:
+            out["robust_round"] = int(self._round_counter)
+            out["res_seen"] = int(self._res_seen)
+            if self._reservoir:
+                out["reservoir"] = np.stack(self._reservoir)
         return out
 
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
-        self._round_counter = int(state.get("robust_round", 0))
-        self._res_seen = int(state.get("res_seen", 0))
-        res = state.get("reservoir")
-        self._reservoir = (
-            [np.array(r, np.float32) for r in res] if res is not None else []
-        )
-        # round-close rng state is exactly "fresh for the current round
-        # counter" — the same state _finish() leaves behind
-        self._res_rng = _reservoir_rng(self.config, self._round_counter)
+        with self._lock:
+            self._round_counter = int(state.get("robust_round", 0))
+            self._res_seen = int(state.get("res_seen", 0))
+            res = state.get("reservoir")
+            self._reservoir = (
+                [np.array(r, np.float32) for r in res]
+                if res is not None else []
+            )
+            # round-close rng state is exactly "fresh for the current round
+            # counter" — the same state _finish() leaves behind
+            self._res_rng = _reservoir_rng(self.config, self._round_counter)
 
     def pop_round_stats(self) -> dict | None:
         """The closed round's Robust/* record (None when no round closed
@@ -380,11 +386,15 @@ class _RobustServerMixin:
     """Shared server-manager wiring: swap in the robust tally and flush its
     Robust/* record per closed round (mirrors comm_stats)."""
 
-    def _init_robust(self, robust_config: RobustDistConfig | None,
-                     robust_stats: dict | None) -> None:
+    def _hoist_robust(self, robust_config: RobustDistConfig | None) -> None:
+        """Validate + stash the defense config. Runs BEFORE super().__init__
+        — the base's single ``_make_aggregator()`` call reads it (the
+        factory seam, ROADMAP item 1)."""
         if robust_config is None:
             raise ValueError(f"{type(self).__name__} needs a robust_config")
         self.robust_config = robust_config
+
+    def _init_robust(self, robust_stats: dict | None) -> None:
         self._robust_stats = robust_stats
         self.aggregator.get_global = lambda: self.global_flat
         # flush the closed round's Robust/* record BEFORE the caller's
@@ -411,12 +421,15 @@ class RobustFedAvgServerManager(_RobustServerMixin, FedAvgServerManager):
 
     def __init__(self, *args, robust_config: RobustDistConfig | None = None,
                  robust_stats: dict | None = None, **kwargs):
+        self._hoist_robust(robust_config)
         super().__init__(*args, **kwargs)
-        self.aggregator = (
+        self._init_robust(robust_stats)
+
+    def _make_aggregator(self):
+        return (
             BufferedRobustDistAggregator if self.buffered_aggregation
             else RobustDistAggregator
-        )(self.worker_num, robust_config, model_desc=self.model_desc)
-        self._init_robust(robust_config, robust_stats)
+        )(self.worker_num, self.robust_config, model_desc=self.model_desc)
 
 
 class RobustCompressedFedAvgServerManager(_RobustServerMixin,
@@ -426,13 +439,18 @@ class RobustCompressedFedAvgServerManager(_RobustServerMixin,
 
     def __init__(self, *args, robust_config: RobustDistConfig | None = None,
                  robust_stats: dict | None = None, **kwargs):
+        self._hoist_robust(robust_config)
         super().__init__(*args, **kwargs)
-        self.aggregator = (
+        self._init_robust(robust_stats)
+
+    def _make_aggregator(self):
+        # get_global is wired by _init_robust (the mixin tail shared by
+        # every robust arm), not here
+        return (
             BufferedRobustCompressedDistAggregator if self.buffered_aggregation
             else RobustCompressedDistAggregator
-        )(self.worker_num, robust_config, self.codec,
+        )(self.worker_num, self.robust_config, self.codec,
           model_desc=self.model_desc)
-        self._init_robust(robust_config, robust_stats)
 
 
 # ---------------------------------------------------------------------------

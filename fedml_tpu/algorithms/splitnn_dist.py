@@ -56,7 +56,7 @@ class SplitMsg:
     MSG_TYPE_C2S_FINAL_VARS = 6
 
     KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
-    KEY_DESC = "model_desc"
+    KEY_DESC = Message.MSG_ARG_KEY_MODEL_DESC
     KEY_ACTS = "acts"
     KEY_GRADS = "acts_grad"
     KEY_STEP_KEY = "step_key"
@@ -144,7 +144,7 @@ class SplitNNServerManager(ServerManager):
         self.turn = 0
         self.losses: list[float] = []
         self._turn_losses: list[jnp.ndarray] = []
-        self.final_cvars: dict[int, Pytree] = {}
+        self.final_cvars: dict[int, Pytree] = {}  # guarded-by: _lock
         self._flat0, self._desc = pack_pytree(jax.tree.map(np.asarray, cvars0))
         self._lock = threading.Lock()
 

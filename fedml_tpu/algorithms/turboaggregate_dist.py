@@ -92,10 +92,10 @@ class TAMessage:
     MSG_TYPE_S2C_INCLUDE = 7       # server-agreed inclusion set
 
     KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
-    KEY_DESC = "model_desc"
+    KEY_DESC = Message.MSG_ARG_KEY_MODEL_DESC
     KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
     KEY_SHARE = "bgw_share"
-    KEY_ROUND = "round_idx"
+    KEY_ROUND = Message.MSG_ARG_KEY_ROUND_IDX
     KEY_WEIGHT = "p_i"  # this client's normalized aggregation weight
     KEY_HOLDERS = "holders"        # share report: ranks whose shares I hold
     KEY_INCLUDE = "include_set"    # ranks whose updates a share-sum includes
@@ -139,12 +139,12 @@ class TAServerManager(ServerManager):
         # sender -> (include_set_tuple, share_sum): share-sums over different
         # inclusion sets are shares of DIFFERENT polynomials and must never
         # be mixed in one reconstruction
-        self._share_sums: dict[int, tuple[tuple[int, ...], np.ndarray]] = {}
-        self._reports: dict[int, tuple[int, ...]] = {}
-        self._include_sent = False
-        self._include_set: list[int] = []
-        self._timed_out = False
-        self._timer: threading.Timer | None = None
+        self._share_sums: dict[int, tuple[tuple[int, ...], np.ndarray]] = {}  # guarded-by: _lock
+        self._reports: dict[int, tuple[int, ...]] = {}  # guarded-by: _lock
+        self._include_sent = False  # guarded-by: _lock
+        self._include_set: list[int] = []  # guarded-by: _lock
+        self._timed_out = False  # guarded-by: _lock
+        self._timer: threading.Timer | None = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def send_init_msg(self) -> None:
@@ -184,7 +184,7 @@ class TAServerManager(ServerManager):
             sync.add_params(TAMessage.KEY_ROUND, self.round_idx)
             sync.add_params(TAMessage.KEY_WEIGHT, self._sample_nums[w] / total)
             if finished:
-                sync.add_params("finished", 1)
+                sync.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
             self.send_message(sync)
 
     # -- aggregation --------------------------------------------------------
@@ -312,7 +312,7 @@ class TAServerManager(ServerManager):
         else:
             self._send_include(include, recipients, rnd)
 
-    def _bucket_max_locked(self) -> int:
+    def _bucket_max_locked(self) -> int:  # lock-held: _lock
         """Size of the largest same-inclusion-set bucket (caller holds the
         lock)."""
         counts: dict[tuple[int, ...], int] = {}
@@ -320,7 +320,7 @@ class TAServerManager(ServerManager):
             counts[include] = counts.get(include, 0) + 1
         return max(counts.values(), default=0)
 
-    def _decide_include_locked(self):
+    def _decide_include_locked(self):  # lock-held: _lock
         """Intersect the reports into the agreed inclusion set (caller holds
         the lock). Returns an explicit ``(action, include, recipients)``
         triple: ``("send", set, live workers)`` normally, ``("abort", ...)``
@@ -401,13 +401,13 @@ class TAServerManager(ServerManager):
             self.send_message(m)
 
     def _timeout(self) -> None:
-        self._timed_out = True
         # if clients reported a share dropout, the timer's job is to declare
         # the silent ranks dead and broadcast the inclusion set — the
         # incoming (re)submissions then close the round normally. A bucket
         # that can already reconstruct takes precedence over subset recovery
         # (privacy guard, see _on_share_report).
         with self._lock:
+            self._timed_out = True
             rnd = self.round_idx
             if (self._reports and not self._include_sent
                     and self._bucket_max_locked() < self.threshold + 1):
@@ -501,10 +501,10 @@ class TAClientManager(ClientManager):
         self._lock = threading.Lock()
         # shares can arrive before this client finishes its own training —
         # buffer per round
-        self._peer_shares: dict[int, dict[int, np.ndarray]] = {}
+        self._peer_shares: dict[int, dict[int, np.ndarray]] = {}  # guarded-by: _lock
         # round -> inclusion set submitted (dict, not set: a resubmission is
         # warranted only when the agreed set differs from what went out)
-        self._submitted: dict[int, tuple[int, ...]] = {}
+        self._submitted: dict[int, tuple[int, ...]] = {}  # guarded-by: _lock
         self._p_i: float | None = None
         # pre-share dropout recovery: if a peer's share hasn't arrived
         # share_timeout seconds after our own shares went out, report the
@@ -532,7 +532,7 @@ class TAClientManager(ClientManager):
         self.send_message(out)
 
     def _on_sync(self, msg: Message) -> None:
-        if msg.get("finished"):
+        if msg.get(Message.MSG_ARG_KEY_FINISHED):
             self.finish()
             return
         round_idx = int(msg.get(TAMessage.KEY_ROUND))
@@ -621,6 +621,7 @@ class TAClientManager(ClientManager):
         out.add_params(TAMessage.KEY_ROUND, round_idx)
         self.send_message(out)
 
+    # lock-held: _lock
     def _stash_share(self, round_idx: int, sender: int, share: np.ndarray) -> None:
         self._peer_shares.setdefault(round_idx, {})[sender] = share
 

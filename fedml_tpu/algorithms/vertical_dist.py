@@ -50,7 +50,7 @@ class VFLMsg:
     MSG_TYPE_H2G_FINAL_VARS = 6
 
     KEY_MODEL = Message.MSG_ARG_KEY_MODEL_PARAMS
-    KEY_DESC = "model_desc"
+    KEY_DESC = Message.MSG_ARG_KEY_MODEL_DESC
     KEY_STEP = "step"
     KEY_LOGITS = "logits"
     KEY_GRAD = "logit_grad"

@@ -1,0 +1,42 @@
+"""fedlint: AST-based invariant checker for this repo's documented contracts.
+
+The hardest-won correctness rules in this codebase used to live only in
+prose — the ``_round_lock``/``_edge_lock`` discipline (a missed lock caused
+the real cross-silo deadlock fixed in PR 10), the ``MSG_ARG_KEY_*`` wire
+contract, the construct-then-overwrite aggregator seam ROADMAP item 1 named
+as the composition blocker, the jit-purity requirements of the engine's
+lowered programs, and the canonical ``Comm/``/``Robust/``/``Async/``
+metric-key namespace. This package machine-checks them on every PR:
+
+- :mod:`fedml_tpu.analysis.core` — one shared AST walk per file, the
+  :class:`~fedml_tpu.analysis.core.Rule` plugin surface, the cross-file
+  :class:`~fedml_tpu.analysis.core.Project` index (class hierarchy,
+  annotations), and ``# fedlint: disable=<rule> -- <why>`` waivers that
+  REQUIRE a justification.
+- :mod:`fedml_tpu.analysis.rules` — the built-in rule set (see
+  docs/STATIC_ANALYSIS.md for the catalog and each rule's provenance).
+- :mod:`fedml_tpu.analysis.config` — ``[tool.fedlint]`` pyproject section.
+- :mod:`fedml_tpu.analysis.report` — text | json rendering.
+
+``tools/fedlint.py`` is the CLI; tier-1 runs it as a zero-findings gate
+over ``fedml_tpu/`` and ``tools/`` (tests/test_static_analysis.py).
+"""
+
+from fedml_tpu.analysis.config import FedlintConfig, load_config
+from fedml_tpu.analysis.core import Finding, Project, Rule, Waiver, run_analysis
+from fedml_tpu.analysis.report import render_json, render_text
+from fedml_tpu.analysis.rules import all_rules, make_rules
+
+__all__ = [
+    "FedlintConfig",
+    "Finding",
+    "Project",
+    "Rule",
+    "Waiver",
+    "all_rules",
+    "load_config",
+    "make_rules",
+    "render_json",
+    "render_text",
+    "run_analysis",
+]

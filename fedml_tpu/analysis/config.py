@@ -1,0 +1,111 @@
+"""``[tool.fedlint]`` configuration (pyproject.toml).
+
+Python 3.10 has no ``tomllib``; ``tomli`` is preferred when present and a
+minimal line-oriented fallback parses just this section otherwise (string
+scalars, booleans, and one-line string arrays — all the section uses), so
+the gate never grows a dependency the container may lack.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from pathlib import Path
+
+DEFAULT_RULES = (
+    "guarded-by",
+    "overwrite-after-super",
+    "wire-contract",
+    "traced-purity",
+    "metric-keys",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class FedlintConfig:
+    """Resolved rule selection + scan scope."""
+
+    paths: tuple[str, ...] = ("fedml_tpu", "tools")
+    select: tuple[str, ...] = DEFAULT_RULES
+    exclude: tuple[str, ...] = ()
+    # metric-keys: canonical prefixes and the module(s) allowed to define
+    # literals under them
+    # fedlint: disable=metric-keys -- the prefix grammar the rule enforces, not record keys
+    metric_prefixes: tuple[str, ...] = ("Comm/", "Robust/", "Async/", "Fleet/")
+    metric_modules: tuple[str, ...] = ("fedml_tpu/obs/metrics.py",)
+    # traced-purity: banned host-call patterns inside lowered functions
+    banned_traced_calls: tuple[str, ...] = (
+        "time.time", "np.random.*", "numpy.random.*", "print",
+        "datetime.now", "datetime.datetime.now",
+    )
+
+
+def _parse_fallback(text: str) -> dict:
+    """Line-oriented ``[tool.fedlint]`` extraction for stdlibs without a
+    TOML parser: handles `key = "str"`, `key = true/false`, and one-line
+    `key = ["a", "b"]` arrays."""
+    section: dict = {}
+    in_section = False
+    for line in text.splitlines():
+        stripped = line.strip()
+        if stripped.startswith("["):
+            in_section = stripped == "[tool.fedlint]"
+            continue
+        if not in_section or not stripped or stripped.startswith("#"):
+            continue
+        m = re.match(r"([\w\-]+)\s*=\s*(.+)$", stripped)
+        if not m:
+            continue
+        key, raw = m.group(1), m.group(2).strip()
+        if raw.startswith("["):
+            section[key] = re.findall(r'"([^"]*)"', raw)
+        elif raw.startswith('"'):
+            section[key] = raw.strip('"')
+        elif raw in ("true", "false"):
+            section[key] = raw == "true"
+    return section
+
+
+def _load_section(pyproject: Path) -> dict:
+    text = pyproject.read_text()
+    try:
+        import tomli
+
+        return tomli.loads(text).get("tool", {}).get("fedlint", {})
+    except ImportError:
+        try:
+            import tomllib  # py3.11+
+
+            return tomllib.loads(text).get("tool", {}).get("fedlint", {})
+        except ImportError:
+            return _parse_fallback(text)
+
+
+def load_config(start: str | Path | None = None) -> FedlintConfig:
+    """Resolve ``[tool.fedlint]`` from the nearest pyproject.toml at or
+    above ``start`` (default: cwd). Missing file/section -> defaults."""
+    here = Path(start) if start is not None else Path.cwd()
+    if here.is_file():
+        here = here.parent
+    for candidate in (here, *here.resolve().parents):
+        pyproject = candidate / "pyproject.toml"
+        if pyproject.exists():
+            section = _load_section(pyproject)
+            break
+    else:
+        section = {}
+    defaults = FedlintConfig()
+
+    def tup(key: str, fallback: tuple[str, ...]) -> tuple[str, ...]:
+        value = section.get(key)
+        return tuple(value) if value is not None else fallback
+
+    return FedlintConfig(
+        paths=tup("paths", defaults.paths),
+        select=tup("select", defaults.select),
+        exclude=tup("exclude", defaults.exclude),
+        metric_prefixes=tup("metric-prefixes", defaults.metric_prefixes),
+        metric_modules=tup("metric-modules", defaults.metric_modules),
+        banned_traced_calls=tup("banned-traced-calls",
+                                defaults.banned_traced_calls),
+    )

@@ -1,0 +1,449 @@
+"""fedlint core: shared AST walk, cross-file project index, waivers.
+
+One :class:`SourceFile` per ``.py`` file carries the parsed tree plus the
+comment map (extracted with :mod:`tokenize`, so ``#`` inside string
+literals never reads as an annotation). Rules run in two passes —
+``collect`` (per file, builds cross-file state) then ``check``/``finalize``
+(emit findings) — so contracts that span files (wire keys written in one
+module and read in another, lock annotations inherited across the class
+diamond) need no per-rule file ordering.
+
+Waivers: ``# fedlint: disable=<rule>[,<rule>...] -- <justification>`` on
+the finding's line (or a standalone comment on the line above) suppresses
+the finding but keeps it enumerable in the report. A waiver WITHOUT a
+justification is itself a finding (rule ``waiver``), as is a waiver that
+suppresses nothing — waivers must stay honest and minimal.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+# annotation / directive comment grammar (docs/STATIC_ANALYSIS.md)
+_WAIVER_RE = re.compile(
+    r"#\s*fedlint:\s*disable=([\w\-,\s]+?)(?:\s*--\s*(.+))?\s*$"
+)
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w]+)")
+_LOCK_HELD_RE = re.compile(r"#\s*lock-held:\s*([\w,\s]+)")
+
+# builtin coercions are value plumbing, not construction: a subclass
+# re-coercing `self.x = bool(x)` is not the construct-then-overwrite seam
+_COERCIONS = frozenset({
+    "bool", "int", "float", "str", "bytes", "tuple", "list", "dict", "set",
+    "frozenset",
+})
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at a source location."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    waived: bool = False
+    waiver_reason: str | None = None
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "col": self.col,
+            "message": self.message,
+            "waived": self.waived,
+            "waiver_reason": self.waiver_reason,
+        }
+
+
+@dataclasses.dataclass
+class Waiver:
+    """One ``# fedlint: disable=`` directive."""
+
+    path: str
+    line: int
+    rules: tuple[str, ...]
+    reason: str | None
+    used: bool = False
+
+    def to_dict(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rules": list(self.rules),
+            "reason": self.reason,
+            "used": self.used,
+        }
+
+
+class SourceFile:
+    """A parsed module: tree + per-line comments + waiver directives."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        # lineno -> full comment text (tokenize: '#' inside strings is NOT
+        # a comment); a line holds at most one comment token
+        self.comments: dict[int, str] = {}
+        # lines whose only content is a comment (standalone): a waiver or
+        # annotation here applies to the NEXT line's statement
+        self.standalone_comments: set[int] = set()
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                line_no = tok.start[0]
+                self.comments[line_no] = tok.string
+                if tok.line[: tok.start[1]].strip() == "":
+                    self.standalone_comments.add(line_no)
+        self.waivers: dict[int, Waiver] = {}
+        for line_no, comment in self.comments.items():
+            m = _WAIVER_RE.search(comment)
+            if m:
+                rules = tuple(
+                    r.strip() for r in m.group(1).split(",") if r.strip()
+                )
+                reason = m.group(2)
+                self.waivers[line_no] = Waiver(
+                    self.path, line_no, rules,
+                    reason.strip() if reason else None,
+                )
+
+    def comment_on(self, line: int) -> str | None:
+        return self.comments.get(line)
+
+    def guarded_annotation(self, line: int) -> str | None:
+        """``# guarded-by: <lock>`` on this line (or standalone above)."""
+        return self._annotation(_GUARDED_RE, line)
+
+    def lock_held_annotation(self, line: int) -> list[str]:
+        """``# lock-held: <lock>[, <lock>...]`` on this line (or above)."""
+        hit = self._annotation(_LOCK_HELD_RE, line)
+        if hit is None:
+            return []
+        return [name.strip() for name in hit.split(",") if name.strip()]
+
+    def _annotation(self, pattern: re.Pattern, line: int) -> str | None:
+        for candidate in (line, line - 1):
+            comment = self.comments.get(candidate)
+            if comment is None:
+                continue
+            if candidate == line - 1 and candidate not in self.standalone_comments:
+                continue
+            m = pattern.search(comment)
+            if m:
+                return m.group(1)
+        return None
+
+    def waiver_for(self, rule: str, line: int) -> Waiver | None:
+        """Waiver applying to a finding of ``rule`` at ``line``: same line,
+        or a standalone directive comment on the line directly above."""
+        for candidate in (line, line - 1):
+            w = self.waivers.get(candidate)
+            if w is None:
+                continue
+            if candidate == line - 1 and candidate not in self.standalone_comments:
+                continue
+            if rule in w.rules:
+                return w
+        return None
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    """Per-class facts the cross-file rules need: the base-name chain, what
+    ``__init__`` constructs, and the concurrency annotations."""
+
+    name: str
+    bases: tuple[str, ...]
+    file: SourceFile
+    node: ast.ClassDef
+    init_node: ast.FunctionDef | None = None
+    # attrs `self.X = <call>`-constructed in __init__ -> assignment line
+    init_constructed: dict[str, int] = dataclasses.field(default_factory=dict)
+    # every `self.X = ...` in __init__ (constructed or not)
+    init_assigned: set[str] = dataclasses.field(default_factory=set)
+    # first line of the `super().__init__(...)` call in __init__, if any
+    super_call_line: int | None = None
+    # `# guarded-by:` declarations: attr -> lock name
+    guarded: dict[str, str] = dataclasses.field(default_factory=dict)
+    # lines carrying a guarded-by declaration (the declaration is exempt)
+    guard_decl_lines: set[int] = dataclasses.field(default_factory=set)
+    # `# lock-held:` method annotations: method name -> lock names
+    lock_held: dict[str, tuple[str, ...]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+def _base_name(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _self_attr_target(node: ast.stmt) -> str | None:
+    """`self.X = ...` / `self.X: T = ...` -> X (single-target only)."""
+    if isinstance(node, ast.Assign) and len(node.targets) == 1:
+        target = node.targets[0]
+    elif isinstance(node, ast.AnnAssign):
+        target = node.target
+    else:
+        return None
+    if (isinstance(target, ast.Attribute)
+            and isinstance(target.value, ast.Name)
+            and target.value.id == "self"):
+        return target.attr
+    return None
+
+
+def _is_construction(value: ast.expr | None) -> bool:
+    """True for `self.X = <call>` where the call is a real construction
+    (not a builtin coercion of an argument)."""
+    if not isinstance(value, ast.Call):
+        return False
+    func = value.func
+    if isinstance(func, ast.Name) and func.id in _COERCIONS:
+        return False
+    return True
+
+
+def _is_super_init_call(node: ast.stmt) -> bool:
+    """`super().__init__(...)` or `SomeClass.__init__(self, ...)`."""
+    if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
+        return False
+    func = node.value.func
+    if not (isinstance(func, ast.Attribute) and func.attr == "__init__"):
+        return False
+    owner = func.value
+    if (isinstance(owner, ast.Call) and isinstance(owner.func, ast.Name)
+            and owner.func.id == "super"):
+        return True
+    # explicit-base form used by the diamond tips (Buffered* variants)
+    return isinstance(owner, (ast.Name, ast.Attribute))
+
+
+def _index_class(file: SourceFile, node: ast.ClassDef) -> ClassInfo:
+    info = ClassInfo(
+        name=node.name,
+        bases=tuple(b for b in map(_base_name, node.bases) if b),
+        file=file,
+        node=node,
+    )
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        held = file.lock_held_annotation(item.lineno)
+        if held:
+            info.lock_held[item.name] = tuple(held)
+        for stmt in ast.walk(item):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+                continue
+            attr = _self_attr_target(stmt)
+            if attr is None:
+                continue
+            lock = file.guarded_annotation(stmt.lineno)
+            if lock is not None:
+                info.guarded.setdefault(attr, lock)
+                info.guard_decl_lines.add(stmt.lineno)
+        if item.name != "__init__":
+            continue
+        info.init_node = item
+        for stmt in item.body:
+            if _is_super_init_call(stmt):
+                if info.super_call_line is None:
+                    info.super_call_line = stmt.lineno
+                continue
+            for sub in ast.walk(stmt):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                attr = _self_attr_target(sub)
+                if attr is None:
+                    continue
+                info.init_assigned.add(attr)
+                if _is_construction(sub.value):
+                    info.init_constructed.setdefault(attr, sub.lineno)
+    return info
+
+
+class Project:
+    """Cross-file index: every class, with by-name ancestor resolution."""
+
+    def __init__(self):
+        self.files: list[SourceFile] = []
+        # EVERY class definition — duplicate simple names included, so a
+        # name collision (two flax modules called SqueezeExcite, say) can
+        # never silently exempt the later class from the per-class rules
+        self.all_classes: list[ClassInfo] = []
+        # simple name -> first definition, for base resolution only
+        # (deterministic because files arrive sorted)
+        self.classes: dict[str, ClassInfo] = {}
+
+    def index(self, files: list[SourceFile]) -> None:
+        self.files = files
+        for file in files:
+            for node in ast.walk(file.tree):
+                if isinstance(node, ast.ClassDef):
+                    info = _index_class(file, node)
+                    self.all_classes.append(info)
+                    self.classes.setdefault(node.name, info)
+
+    def ancestors(self, info: ClassInfo) -> list[ClassInfo]:
+        """Transitive base classes resolvable by simple name, nearest
+        first; cycles and unknown bases are skipped."""
+        out: list[ClassInfo] = []
+        seen = {info.name}
+        queue = list(info.bases)
+        while queue:
+            base = queue.pop(0)
+            if base in seen:
+                continue
+            seen.add(base)
+            base_info = self.classes.get(base)
+            if base_info is None:
+                continue
+            out.append(base_info)
+            queue.extend(base_info.bases)
+        return out
+
+    def effective_guarded(self, info: ClassInfo) -> dict[str, str]:
+        """A class's guarded-field map, own declarations first, then
+        inherited ones (the subclass may re-declare under another lock)."""
+        merged: dict[str, str] = {}
+        for ci in [info, *self.ancestors(info)]:
+            for attr, lock in ci.guarded.items():
+                merged.setdefault(attr, lock)
+        return merged
+
+    def effective_lock_held(self, info: ClassInfo,
+                            method: str) -> tuple[str, ...]:
+        """``# lock-held:`` annotation for a method, inherited along the
+        base chain (an override of a lock-held method keeps the contract
+        unless it re-annotates)."""
+        for ci in [info, *self.ancestors(info)]:
+            if method in ci.lock_held:
+                return ci.lock_held[method]
+        return ()
+
+
+class Rule:
+    """One pluggable invariant. Subclasses set ``name``/``description`` and
+    implement any of the three hooks."""
+
+    name = "rule"
+    description = ""
+
+    def collect(self, file: SourceFile, project: Project) -> None:
+        """Pass 1, per file: accumulate cross-file state on ``self``."""
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        """Pass 2, per file: emit this file's findings."""
+        return []
+
+    def finalize(self, project: Project) -> list[Finding]:
+        """Pass 2, once: emit cross-file findings (e.g. never-read keys)."""
+        return []
+
+
+def discover_files(paths: list[str], exclude: tuple[str, ...] = ()) -> list[Path]:
+    """``.py`` files under the given files/directories, sorted, minus
+    ``__pycache__`` and any path whose POSIX form matches an exclude glob."""
+    out: set[Path] = set()
+    for raw in paths:
+        p = Path(raw)
+        if p.is_file() and p.suffix == ".py":
+            out.add(p)
+        elif p.is_dir():
+            out.update(f for f in p.rglob("*.py")
+                       if "__pycache__" not in f.parts)
+    kept = []
+    for f in sorted(out):
+        posix = f.as_posix()
+        if any(Path(posix).match(pattern) for pattern in exclude):
+            continue
+        kept.append(f)
+    return kept
+
+
+def run_analysis(
+    paths: list[str],
+    rules: list[Rule],
+    exclude: tuple[str, ...] = (),
+    root: str | Path | None = None,
+) -> tuple[list[Finding], list[Waiver], list[str]]:
+    """Run ``rules`` over every ``.py`` under ``paths``.
+
+    Returns ``(findings, waivers, scanned)``: ALL findings (waived ones
+    flagged, unjustified/unused waivers surfaced as rule ``waiver``
+    findings), every waiver directive seen, and the scanned file list.
+    Paths in findings are relative to ``root`` when given."""
+    root = Path(root) if root is not None else None
+    files: list[SourceFile] = []
+    findings: list[Finding] = []
+    for path in discover_files(paths, exclude):
+        display = str(path)
+        if root is not None:
+            try:
+                display = str(path.resolve().relative_to(root.resolve()))
+            except ValueError:
+                pass
+        try:
+            files.append(SourceFile(display, path.read_text()))
+        except SyntaxError as e:
+            findings.append(Finding(
+                "parse-error", display, e.lineno or 0, e.offset or 0,
+                f"unparseable module: {e.msg}",
+            ))
+    project = Project()
+    project.index(files)
+    for rule in rules:
+        for file in files:
+            rule.collect(file, project)
+    for rule in rules:
+        for file in files:
+            findings.extend(rule.check(file, project))
+        findings.extend(rule.finalize(project))
+
+    # waiver application: suppress (but keep) matching findings
+    by_path = {f.path: f for f in files}
+    active = {rule.name for rule in rules}
+    for finding in findings:
+        file = by_path.get(finding.path)
+        if file is None:
+            continue
+        waiver = file.waiver_for(finding.rule, finding.line)
+        if waiver is not None and waiver.reason is not None:
+            finding.waived = True
+            finding.waiver_reason = waiver.reason
+            waiver.used = True
+        elif waiver is not None:
+            # matched but unjustified: the finding stays live and the
+            # directive is reported below
+            waiver.used = True
+
+    waivers = [w for f in files for w in f.waivers.values()]
+    for waiver in waivers:
+        if waiver.reason is None:
+            findings.append(Finding(
+                "waiver", waiver.path, waiver.line, 0,
+                f"waiver for {', '.join(waiver.rules)} has no justification "
+                "(write `# fedlint: disable=<rule> -- <why>`)",
+            ))
+        elif not waiver.used and any(r in active for r in waiver.rules):
+            findings.append(Finding(
+                "waiver", waiver.path, waiver.line, 0,
+                f"waiver for {', '.join(waiver.rules)} suppresses nothing — "
+                "remove it",
+            ))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings, waivers, [f.path for f in files]

@@ -1,0 +1,61 @@
+"""fedlint reporting: text for humans/CI logs, json for tooling.
+
+Both renderers receive the FULL finding list (waived included) so every
+report enumerates the active waivers next to the live findings — a waiver
+that hides a violation silently would defeat the gate's point.
+"""
+
+from __future__ import annotations
+
+import json
+
+from fedml_tpu.analysis.core import Finding, Waiver
+
+REPORT_SCHEMA_VERSION = 1
+
+
+def live_findings(findings: list[Finding]) -> list[Finding]:
+    return [f for f in findings if not f.waived]
+
+
+def render_text(findings: list[Finding], waivers: list[Waiver],
+                scanned: list[str], rule_names: list[str]) -> str:
+    lines: list[str] = []
+    live = live_findings(findings)
+    for f in live:
+        lines.append(f"{f.location()}: {f.rule}: {f.message}")
+    waived = [f for f in findings if f.waived]
+    if waived:
+        lines.append("")
+        lines.append(f"waived ({len(waived)}):")
+        for f in waived:
+            lines.append(
+                f"  {f.location()}: {f.rule}: {f.message} "
+                f"[waived: {f.waiver_reason}]"
+            )
+    lines.append("")
+    lines.append(
+        f"fedlint: {len(live)} finding(s), {len(waived)} waived, "
+        f"{len(scanned)} file(s), rules: {', '.join(rule_names)}"
+    )
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], waivers: list[Waiver],
+                scanned: list[str], rule_names: list[str]) -> str:
+    live = live_findings(findings)
+    return json.dumps(
+        {
+            "schema_version": REPORT_SCHEMA_VERSION,
+            "findings": [f.to_dict() for f in findings],
+            "waivers": [w.to_dict() for w in waivers],
+            "files_scanned": scanned,
+            "rules": rule_names,
+            "summary": {
+                "findings": len(live),
+                "waived": len(findings) - len(live),
+                "files": len(scanned),
+            },
+        },
+        indent=2,
+    )

@@ -1,0 +1,46 @@
+"""Built-in fedlint rules (docs/STATIC_ANALYSIS.md is the catalog).
+
+Each rule class is self-contained and stateful per run: ``make_rules``
+builds FRESH instances for a given config — rule objects accumulate
+cross-file state in ``collect`` and must never be shared between runs.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.analysis.config import FedlintConfig
+from fedml_tpu.analysis.core import Rule
+from fedml_tpu.analysis.rules.guarded_by import GuardedByRule
+from fedml_tpu.analysis.rules.metric_keys import MetricKeysRule
+from fedml_tpu.analysis.rules.overwrite_after_super import OverwriteAfterSuperRule
+from fedml_tpu.analysis.rules.traced_purity import TracedPurityRule
+from fedml_tpu.analysis.rules.wire_contract import WireContractRule
+
+_REGISTRY = {
+    cls.name: cls
+    for cls in (
+        GuardedByRule,
+        OverwriteAfterSuperRule,
+        WireContractRule,
+        TracedPurityRule,
+        MetricKeysRule,
+    )
+}
+
+
+def all_rules() -> dict[str, type[Rule]]:
+    """Rule name -> class, the full registry (for --list-rules)."""
+    return dict(_REGISTRY)
+
+
+def make_rules(config: FedlintConfig) -> list[Rule]:
+    """Fresh rule instances for the config's ``select`` list, in registry
+    order. Unknown names raise — a typo in pyproject must not silently
+    skip a gate."""
+    unknown = [name for name in config.select if name not in _REGISTRY]
+    if unknown:
+        raise ValueError(
+            f"unknown fedlint rule(s) {unknown}; known: {sorted(_REGISTRY)}"
+        )
+    return [
+        _REGISTRY[name](config) for name in _REGISTRY if name in config.select
+    ]

@@ -1,0 +1,137 @@
+"""guarded-by: lock-discipline checking for annotated fields.
+
+Provenance: the ``_round_lock`` critical-section contract in
+``fedavg_distributed.FedAvgServerManager`` (CHANGES.md PR 5/8/9 — "
+staleness/exclusion checks and the tally are one critical section") and the
+``_edge_lock`` discipline in ``async_agg/tree.py`` whose absence caused the
+real cross-silo deadlock fixed in PR 10. The prose contract becomes
+machine-checked:
+
+- a field DECLARED ``self.x = ...  # guarded-by: <lock>`` may only be
+  read/written on ``self`` inside ``with self.<lock>:`` or in a method
+  annotated ``# lock-held: <lock>`` (the callee side of "caller holds the
+  lock" docstrings);
+- declarations inherit: a subclass touching a base-declared field in
+  another file is held to the same lock (the class index resolves bases by
+  name across every scanned file);
+- ``__init__`` and the declaration lines themselves are exempt (the object
+  is not shared during construction), as are deferred closures' bodies —
+  no: closures are checked with NO locks held, because they run later, on
+  whatever thread calls them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fedml_tpu.analysis.core import ClassInfo, Finding, Project, Rule, SourceFile
+
+
+def _with_locks(node: ast.With) -> set[str]:
+    """Lock names acquired by ``with self.<name>[, ...]:`` items."""
+    out: set[str] = set()
+    for item in node.items:
+        expr = item.context_expr
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"):
+            out.add(expr.attr)
+    return out
+
+
+class _MethodWalk(ast.NodeVisitor):
+    def __init__(self, rule: str, file: SourceFile, info: ClassInfo,
+                 guarded: dict[str, str], held: set[str],
+                 ancestors: list[ClassInfo]):
+        self.rule = rule
+        self.file = file
+        self.info = info
+        self.guarded = guarded
+        self.held = held
+        self.ancestors = ancestors
+        self.findings: list[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        added = _with_locks(node) - self.held
+        for item in node.items:
+            self.visit(item.context_expr)
+        self.held |= added
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held -= added
+
+    visit_AsyncWith = visit_With
+
+    def _deferred(self, node: ast.AST) -> None:
+        # a nested def/lambda runs later on an arbitrary thread: whatever
+        # locks the enclosing method holds will NOT be held then
+        inner = _MethodWalk(self.rule, self.file, self.info, self.guarded,
+                            set(), self.ancestors)
+        for child in ast.iter_child_nodes(node):
+            inner.visit(child)
+        self.findings.extend(inner.findings)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._deferred(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._deferred(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._deferred(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (isinstance(node.value, ast.Name) and node.value.id == "self"
+                and node.attr in self.guarded
+                and node.lineno not in self.info.guard_decl_lines):
+            lock = self.guarded[node.attr]
+            if lock not in self.held:
+                self.findings.append(Finding(
+                    "guarded-by", self.file.path, node.lineno,
+                    node.col_offset,
+                    f"self.{node.attr} is guarded by self.{lock} "
+                    f"(declared in {self._decl_site(node.attr)}) but is "
+                    "touched without it — wrap in `with self."
+                    f"{lock}:` or annotate the method `# lock-held: {lock}`",
+                ))
+        self.generic_visit(node)
+
+    def _decl_site(self, attr: str) -> str:
+        # nearest declaring class in the chain, for the message only
+        for info in [self.info, *self.ancestors]:
+            if attr in info.guarded:
+                return info.name
+        return self.info.name
+
+
+class GuardedByRule(Rule):
+    name = "guarded-by"
+    description = ("fields annotated `# guarded-by: <lock>` are only "
+                   "touched under `with self.<lock>:` or in `# lock-held:` "
+                   "methods")
+
+    def __init__(self, config):
+        self.config = config
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in project.all_classes:
+            if info.file is not file:
+                continue
+            guarded = project.effective_guarded(info)
+            if not guarded:
+                continue
+            ancestors = project.ancestors(info)
+            for item in info.node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                if item.name == "__init__":
+                    continue  # construction: the object is not shared yet
+                held = set(project.effective_lock_held(info, item.name))
+                walk = _MethodWalk(self.name, file, info, guarded, held,
+                                   ancestors)
+                for stmt in item.body:
+                    walk.visit(stmt)
+                findings.extend(walk.findings)
+        return findings

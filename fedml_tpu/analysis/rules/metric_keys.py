@@ -1,0 +1,53 @@
+"""metric-keys: canonical Comm/ Robust/ Async/ Fleet/ record keys only.
+
+Provenance: ``obs/metrics.py`` is the single home of the canonical metric
+namespace ("Canonical bytes-on-wire metric keys", PR 1/6/9) — the sim
+engine, the wire-path servers, the smokes, and the report renderers all
+join records BY these strings, so an ad-hoc literal (``"Robust/ClipFrac"``
+vs ``ROBUST_CLIP_FRACTION``) silently forks the stream: the record lands,
+nothing joins it, and the dashboard reads zero. Any string literal under a
+canonical prefix outside the defining module(s) is a finding — spell it
+``metricslib.<CONSTANT>``.
+
+Literals containing whitespace are ignored: prose in docstrings may
+mention a key family ("the Async/* totals") without naming a record key —
+record keys never contain spaces.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
+
+
+class MetricKeysRule(Rule):
+    name = "metric-keys"
+    description = ("Comm/ Robust/ Async/ Fleet/ record keys must come from "
+                   "the obs.metrics constants, not ad-hoc literals")
+
+    def __init__(self, config):
+        self.config = config
+        self.prefixes = tuple(config.metric_prefixes)
+        self.modules = {m.replace("\\", "/") for m in config.metric_modules}
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        path = file.path.replace("\\", "/")
+        if any(path.endswith(module) for module in self.modules):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(file.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            value = node.value
+            if any(ch.isspace() for ch in value):
+                continue
+            if value.startswith(self.prefixes):
+                findings.append(Finding(
+                    self.name, file.path, node.lineno, node.col_offset,
+                    f"ad-hoc metric key literal {value!r} — import the "
+                    "constant from fedml_tpu.obs.metrics (records join by "
+                    "these strings; a fork reads as zero downstream)",
+                ))
+        return findings

@@ -1,0 +1,60 @@
+"""overwrite-after-super: the construct-then-overwrite __init__ seam.
+
+Provenance: ROADMAP open item 1 — "today every async/tree/robust/
+compressed subclass construct-then-overwrites the base aggregator, which
+is exactly why composition is hard". A subclass ``__init__`` that
+reassigns an attribute the base ``__init__`` already CONSTRUCTED (assigned
+from a real call, not a builtin coercion) wastes the base's construction
+and forks the configuration seam: the base can never learn the subclass's
+config, so every new plane multiplies the diamond. The fix shape is a
+factory method (``_make_aggregator``) the base calls once, with subclass
+config hoisted ABOVE ``super().__init__``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile, _self_attr_target
+
+
+class OverwriteAfterSuperRule(Rule):
+    name = "overwrite-after-super"
+    description = ("a subclass __init__ must not reassign an attribute a "
+                   "base __init__ already constructed — use a factory seam")
+
+    def __init__(self, config):
+        self.config = config
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for info in project.all_classes:
+            if info.file is not file or info.init_node is None:
+                continue
+            if info.super_call_line is None:
+                continue
+            constructed: dict[str, tuple[str, int]] = {}
+            for ancestor in project.ancestors(info):
+                for attr, line in ancestor.init_constructed.items():
+                    constructed.setdefault(attr, (ancestor.name, line))
+            if not constructed:
+                continue
+            for stmt in info.init_node.body:
+                if stmt.lineno <= info.super_call_line:
+                    continue
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    attr = _self_attr_target(sub)
+                    if attr is None or attr not in constructed:
+                        continue
+                    base, base_line = constructed[attr]
+                    findings.append(Finding(
+                        self.name, file.path, sub.lineno, sub.col_offset,
+                        f"self.{attr} reassigned after super().__init__, "
+                        f"but {base}.__init__ (line {base_line}) already "
+                        "constructs it — construct-then-overwrite; hoist "
+                        "the config above super().__init__ and build once "
+                        "through a factory method",
+                    ))
+        return findings

@@ -1,0 +1,121 @@
+"""traced-purity: no host calls inside jit/pjit/shard_map-lowered code.
+
+Provenance: every engine program lowers through ``parallel/dispatch.lower``
+(or ``jax.jit`` / ``compat.shard_map`` directly — sim/engine.py, PR 7), and
+a host call inside a traced body is a classic silent bug: ``time.time()``
+burns ONE timestamp into the compiled graph forever, ``np.random`` draws
+once at trace time and replays the same "random" numbers every call,
+``print`` fires at trace time only (then never again), ``datetime.now``
+likewise. jax.debug.print / jax.random are the traced-safe counterparts.
+
+Scope: per module — functions (a) decorated with ``jax.jit`` /
+``partial(jax.jit, ...)``, or (b) passed by NAME as the first argument to
+``jax.jit`` / ``compat.shard_map`` / ``dispatch.lower`` /
+``jit_under_mesh`` / ``pallas_call``, plus every ``def`` nested inside
+them. No interprocedural analysis: a helper called from a traced body is
+only scanned if it is itself lowered — the rule catches the direct form.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
+
+_LOWERING_ATTRS = frozenset({
+    "jit", "shard_map", "lower", "jit_under_mesh", "pallas_call",
+})
+
+
+def _dotted(func: ast.expr) -> str | None:
+    """`a.b.c` -> "a.b.c" (Name/Attribute chains only)."""
+    parts: list[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_expr(expr: ast.expr) -> bool:
+    """`jax.jit`, `jit`, `partial(jax.jit, ...)`, `functools.partial(...)`."""
+    dotted = _dotted(expr)
+    if dotted in ("jax.jit", "jit"):
+        return True
+    if isinstance(expr, ast.Call):
+        fn = _dotted(expr.func)
+        if fn in ("partial", "functools.partial") and expr.args:
+            return _is_jit_expr(expr.args[0])
+    return False
+
+
+class TracedPurityRule(Rule):
+    name = "traced-purity"
+    description = ("banned host calls (time.time, np.random.*, print, "
+                   "datetime.now) inside jit/pjit/shard_map-lowered "
+                   "functions")
+
+    def __init__(self, config):
+        self.config = config
+        self.banned = tuple(config.banned_traced_calls)
+
+    def _banned_match(self, dotted: str) -> str | None:
+        for pattern in self.banned:
+            if pattern.endswith(".*"):
+                if dotted.startswith(pattern[:-1]):
+                    return pattern
+            elif dotted == pattern:
+                return pattern
+        return None
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        traced_names: set[str] = set()
+        lambdas: list[tuple[ast.Lambda, str]] = []
+        defs: dict[str, list[ast.FunctionDef]] = {}
+        for node in ast.walk(file.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                if any(_is_jit_expr(d) for d in node.decorator_list):
+                    traced_names.add(node.name)
+            elif isinstance(node, ast.Call):
+                fn = _dotted(node.func)
+                is_lowering = (
+                    fn in ("jax.jit", "jit")
+                    or (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _LOWERING_ATTRS)
+                )
+                if is_lowering and node.args:
+                    target = node.args[0]
+                    if isinstance(target, ast.Name):
+                        traced_names.add(target.id)
+                    elif isinstance(target, ast.Lambda):
+                        lambdas.append((target, fn or node.func.attr))
+
+        findings: list[Finding] = []
+
+        def scan(body_node: ast.AST, owner: str) -> None:
+            for sub in ast.walk(body_node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                if dotted is None:
+                    continue
+                pattern = self._banned_match(dotted)
+                if pattern is not None:
+                    findings.append(Finding(
+                        self.name, file.path, sub.lineno, sub.col_offset,
+                        f"host call {dotted}() inside traced function "
+                        f"`{owner}` (matches banned pattern {pattern!r}) — "
+                        "traced programs must be pure: the value burns "
+                        "into the compiled graph at trace time",
+                    ))
+
+        for name in sorted(traced_names):
+            for fn_def in defs.get(name, []):
+                scan(fn_def, name)
+        for lam, via in lambdas:
+            scan(lam, f"<lambda via {via}>")
+        return findings

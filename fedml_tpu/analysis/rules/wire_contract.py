@@ -1,0 +1,169 @@
+"""wire-contract: every MSG_ARG_KEY_* is written AND read; no raw keys.
+
+Provenance: the typed-message wire contract of ``comm/message.py`` and the
+protocol classes built on it (``MyMessage``, ``TreeMessage``,
+``ClientStatus``) — CHANGES.md PR 5/9 document hard-won compatibilities
+(version echo vs round index, header-only telemetry scalars) that all
+hang off these key constants. Three checks:
+
+- a defined ``MSG_ARG_KEY_*`` constant must be WRITTEN somewhere
+  (``add_params(KEY, ...)`` or a dict-literal key) and READ somewhere
+  (``.get(KEY)`` / subscript) across the scanned tree — a write-only key
+  is dead wire weight, a read-only key is a silent ``None`` at every
+  receiver;
+- no raw string literal may duplicate a key's VALUE — two spellings of
+  one wire field drift independently (alias constants that reference
+  another class's key are fine and resolve to the same canonical name);
+- ``add_params`` must not take a raw string literal key at all: ad-hoc
+  wire fields bypass the contract entirely.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
+
+_KEY_RE = re.compile(r"^MSG_ARG_KEY_\w+$")
+
+
+class WireContractRule(Rule):
+    name = "wire-contract"
+    description = ("MSG_ARG_KEY_* constants must be both written and read; "
+                   "no raw string literal may duplicate or replace one")
+
+    def __init__(self, config):
+        self.config = config
+        # canonical name -> (value, path, line, col)
+        self.defs: dict[str, tuple[str, str, int, int]] = {}
+        # canonical value -> canonical name (first definition wins)
+        self.values: dict[str, str] = {}
+        # positions of the defining Constant nodes (skipped by the
+        # duplicate-literal scan): (path, line, col)
+        self.def_value_sites: set[tuple[str, int, int]] = set()
+        # usage tallies per key name
+        self.written: set[str] = set()
+        self.read: set[str] = set()
+
+    # -- pass 1: definitions + usages ---------------------------------------
+
+    def collect(self, file: SourceFile, project: Project) -> None:
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    self._collect_def(file, stmt)
+        for node in ast.walk(file.tree):
+            if isinstance(node, ast.Call):
+                self._collect_call(node)
+            elif isinstance(node, ast.Subscript):
+                self._mark(node.slice, read=True, written=True)
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if key is not None:
+                        self._mark(key, written=True)
+            elif isinstance(node, ast.Compare):
+                for comp in [node.left, *node.comparators]:
+                    self._mark(comp, read=True, written=True)
+
+    def _collect_def(self, file: SourceFile, stmt: ast.stmt) -> None:
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
+            return
+        target = stmt.targets[0]
+        if not (isinstance(target, ast.Name) and _KEY_RE.match(target.id)):
+            return
+        if isinstance(stmt.value, ast.Constant) and isinstance(
+                stmt.value.value, str):
+            value = stmt.value.value
+            self.defs.setdefault(
+                target.id, (value, file.path, stmt.lineno, stmt.col_offset)
+            )
+            self.values.setdefault(value, target.id)
+            self.def_value_sites.add(
+                (file.path, stmt.value.lineno, stmt.value.col_offset)
+            )
+        # alias definitions (`MyMessage.K = Message.K`) need no tracking:
+        # both spellings share the attribute name, so usage sites of either
+        # already tally against the same canonical key
+
+    def _key_name(self, node: ast.expr) -> str | None:
+        if isinstance(node, ast.Attribute) and _KEY_RE.match(node.attr):
+            return node.attr
+        if isinstance(node, ast.Name) and _KEY_RE.match(node.id):
+            return node.id
+        return None
+
+    def _mark(self, node: ast.expr, read: bool = False,
+              written: bool = False) -> None:
+        name = self._key_name(node)
+        if name is None:
+            return
+        if read:
+            self.read.add(name)
+        if written:
+            self.written.add(name)
+
+    def _collect_call(self, node: ast.Call) -> None:
+        func = node.func
+        if not isinstance(func, ast.Attribute) or not node.args:
+            return
+        if func.attr == "add_params":
+            self._mark(node.args[0], written=True)
+        elif func.attr in ("get", "pop"):
+            self._mark(node.args[0], read=True)
+        else:
+            # any other call position (pack helpers, encode framing):
+            # conservatively counts as both — the rule targets NEVER-used
+            # directions, not exotic plumbing
+            for arg in node.args:
+                self._mark(arg, read=True, written=True)
+
+    # -- pass 2 -------------------------------------------------------------
+
+    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(file.tree):
+            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                    and node.value in self.values):
+                site = (file.path, node.lineno, node.col_offset)
+                if site in self.def_value_sites:
+                    continue
+                findings.append(Finding(
+                    self.name, file.path, node.lineno, node.col_offset,
+                    f"raw string {node.value!r} duplicates wire key "
+                    f"{self.values[node.value]} — use the constant (two "
+                    "spellings of one wire field drift independently)",
+                ))
+            elif (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_params" and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                    and node.args[0].value not in self.values):
+                findings.append(Finding(
+                    self.name, file.path, node.args[0].lineno,
+                    node.args[0].col_offset,
+                    f"ad-hoc wire key {node.args[0].value!r} passed to "
+                    "add_params — define a MSG_ARG_KEY_* constant so the "
+                    "field is part of the checked contract",
+                ))
+        return findings
+
+    def finalize(self, project: Project) -> list[Finding]:
+        findings: list[Finding] = []
+        for name, (value, path, line, col) in sorted(self.defs.items()):
+            if name not in self.written:
+                findings.append(Finding(
+                    self.name, path, line, col,
+                    f"wire key {name} ({value!r}) is never written "
+                    "(no add_params/dict-key site in the scanned tree) — "
+                    "dead contract surface",
+                ))
+            if name not in self.read:
+                findings.append(Finding(
+                    self.name, path, line, col,
+                    f"wire key {name} ({value!r}) is never read "
+                    "(no .get/subscript site in the scanned tree) — every "
+                    "receiver sees None",
+                ))
+        return findings

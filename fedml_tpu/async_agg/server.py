@@ -66,8 +66,10 @@ class _AsyncTallyMixin:
     slot, which is why every defended/encoded fold composes unchanged."""
 
     def _init_async(self) -> None:
-        self.arrivals = 0  # folds since the last emission
-        self.last_folded: dict[int, int] = {}  # worker -> newest version folded
+        # folds since the last emission
+        self.arrivals = 0  # guarded-by: _lock
+        # worker -> newest version folded
+        self.last_folded: dict[int, int] = {}  # guarded-by: _lock
 
     def fold_async(self, index: int, payload, weight: float,
                    upload_version: int) -> bool:
@@ -94,16 +96,23 @@ class _AsyncTallyMixin:
 
     def snapshot_state(self) -> dict:
         out = super().snapshot_state()
-        out["arrivals"] = int(self.arrivals)
-        out["last_folded"] = {str(k): int(v)
-                              for k, v in self.last_folded.items()}
+        # the base released _lock after its snapshot; re-acquire for the
+        # window state (fedlint guarded-by: a concurrent fold_async must
+        # never land between a torn arrivals/last_folded pair)
+        with self._lock:
+            out["arrivals"] = int(self.arrivals)
+            out["last_folded"] = {str(k): int(v)
+                                  for k, v in self.last_folded.items()}
         return out
 
     def restore_state(self, state: dict) -> None:
         super().restore_state(state)
-        self.arrivals = int(state.get("arrivals", 0))
-        self.last_folded = {int(k): int(v)
-                            for k, v in state.get("last_folded", {}).items()}
+        with self._lock:
+            self.arrivals = int(state.get("arrivals", 0))
+            self.last_folded = {
+                int(k): int(v)
+                for k, v in state.get("last_folded", {}).items()
+            }
 
 
 class AsyncFedAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
@@ -161,12 +170,6 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                 "async server mode has no round barrier: the elastic "
                 "round_timeout does not apply"
             )
-        if self.buffered_aggregation:
-            raise ValueError(
-                "async server mode has no buffered A/B arm: the tally is "
-                "streaming by construction (the sync server keeps the "
-                "buffered oracle)"
-            )
         self.buffer_goal = int(buffer_goal) if buffer_goal else self.worker_num
         if not (1 <= self.buffer_goal <= self.worker_num):
             raise ValueError(
@@ -178,7 +181,8 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         self.staleness_weight = str(staleness_weight)
         self._staleness_fn = make_staleness_fn(self.staleness_weight)
         self._async_stats = async_stats
-        self._parked: set[int] = set()  # workers awaiting the next emission
+        # workers awaiting the next emission
+        self._parked: set[int] = set()  # guarded-by: _round_lock
         self._fleet_t0 = time.monotonic()  # liveness epoch for never-seen ranks
         if self.fleet is not None:
             # route tracker transitions through the readmission-aware hook:
@@ -187,9 +191,20 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
             # timeline must show the READMITTED event on that path too
             self.status.on_transition = self._fleet_transition
         # per-emission-window counters + run totals (Async/* metrics)
-        self._window = {"stale": 0, "dup": 0, "staleness_sum": 0}
-        self._totals = {"stale": 0, "dup": 0, "emitted": 0}
-        self.aggregator = self._make_async_aggregator()
+        self._window = {"stale": 0, "dup": 0, "staleness_sum": 0}  # guarded-by: _round_lock
+        self._totals = {"stale": 0, "dup": 0, "emitted": 0}  # guarded-by: _round_lock
+
+    def _make_aggregator(self):
+        # the base __init__'s single construction call (fedlint:
+        # overwrite-after-super): validate-then-delegate, so the async
+        # variants keep overriding only _make_async_aggregator
+        if self.buffered_aggregation:
+            raise ValueError(
+                "async server mode has no buffered A/B arm: the tally is "
+                "streaming by construction (the sync server keeps the "
+                "buffered oracle)"
+            )
+        return self._make_async_aggregator()
 
     def _make_async_aggregator(self):
         return AsyncFedAggregator(self.worker_num)
@@ -400,11 +415,15 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
         self.fleet.record_state(rank, status)
 
     def async_totals(self) -> dict:
-        return {
-            metricslib.ASYNC_MODELS_EMITTED: self._totals["emitted"],
-            metricslib.ASYNC_STALE_FOLDS: self._totals["stale"],
-            metricslib.ASYNC_DUP_UPLOADS: self._totals["dup"],
-        }
+        # under the round lock (fedlint guarded-by): the runner reads the
+        # totals after the protocol finishes, but a late in-flight handler
+        # may still be folding — never serve a torn read
+        with self._round_lock:
+            return {
+                metricslib.ASYNC_MODELS_EMITTED: self._totals["emitted"],
+                metricslib.ASYNC_STALE_FOLDS: self._totals["stale"],
+                metricslib.ASYNC_DUP_UPLOADS: self._totals["dup"],
+            }
 
     def restore_from_checkpoint(self, checkpointer=None,
                                 round_idx: int | None = None) -> int:
@@ -437,14 +456,12 @@ class AsyncRobustFedAvgServerManager(_RobustServerMixin,
 
     def __init__(self, *args, robust_config=None, robust_stats=None,
                  **kwargs):
-        if robust_config is None:
-            raise ValueError(f"{type(self).__name__} needs a robust_config")
-        self._robust_config_pending = robust_config
+        self._hoist_robust(robust_config)
         super().__init__(*args, **kwargs)
-        self._init_robust(robust_config, robust_stats)
+        self._init_robust(robust_stats)
 
     def _make_async_aggregator(self):
         return AsyncRobustFedAggregator(
-            self.worker_num, self._robust_config_pending,
+            self.worker_num, self.robust_config,
             model_desc=self.model_desc,
         )

@@ -172,10 +172,10 @@ class EdgeAggregatorManager(DistributedManager):
         self.client_num_in_total = client_num_in_total
         self.children_are_leaves = bool(children_are_leaves)
         self.aggregator = TierAggregator(child_num)
-        self.stale_uploads = 0
-        self.duplicate_uploads = 0
-        self.discarded_folds = 0
-        self.stale_syncs = 0
+        self.stale_uploads = 0  # guarded-by: _edge_lock
+        self.duplicate_uploads = 0  # guarded-by: _edge_lock
+        self.discarded_folds = 0  # guarded-by: _edge_lock
+        self.stale_syncs = 0  # guarded-by: _edge_lock
         # fleet telemetry (obs/registry.py): cumulative folds forwarded and
         # the current window's fill-start time — the tier's "local step
         # time" is first-fold -> forward. Collected only when the runner
@@ -183,16 +183,16 @@ class EdgeAggregatorManager(DistributedManager):
         # FedAvgClientManager — a process registry installed for unrelated
         # gauges must never change what goes on the wire).
         self.fleet_telemetry = False
-        self.total_folds = 0
-        self._window_t0: float | None = None
-        self._round = 0
+        self.total_folds = 0  # guarded-by: _edge_lock
+        self._window_t0: float | None = None  # guarded-by: _edge_lock
+        self._round = 0  # guarded-by: _edge_lock
         # per-child round of the last ACCEPTED contribution: the tally's
         # first-wins flags reset when the tier forwards its partial, but the
         # tier's round only advances on the next parent sync — a duplicated
         # leg landing in that window would otherwise fold as a phantom
         # first contribution of the NEXT window (and first-wins would then
         # drop the child's genuine next-round upload)
-        self._last_child_round: dict[int, int] = {}
+        self._last_child_round: dict[int, int] = {}  # guarded-by: _edge_lock
         # the up fabric (parent syncs) and down fabric (child uploads) run
         # handlers on DIFFERENT threads: round advance + window discard vs
         # guard + fold must not interleave (same discipline as the flat
@@ -239,15 +239,15 @@ class EdgeAggregatorManager(DistributedManager):
     # -- downlink: parent sync re-broadcast ----------------------------------
 
     def _on_sync_from_parent(self, msg: Message) -> None:
-        if msg.get("finished"):
+        if msg.get(Message.MSG_ARG_KEY_FINISHED):
             out = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, 0, 1)
-            out.add_params("finished", 1)
+            out.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
             self.broadcast_message(out, list(range(1, self.child_num + 1)))
             self.finish()
             return
         ridx = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
-        if ridx is not None:
-            with self._edge_lock:
+        with self._edge_lock:
+            if ridx is not None:
                 if int(ridx) < self._round:
                     # a replayed/reordered old downlink leg (dup faults,
                     # QoS re-delivery): adopting it would REGRESS the round,
@@ -277,12 +277,16 @@ class EdgeAggregatorManager(DistributedManager):
                             self.leaf_base, int(ridx), lost, self._round,
                         )
                     self._round = int(ridx)
+            # snapshot under the lock; the re-broadcast below runs OUTSIDE
+            # it (fedlint guarded-by — and a lock held across a fan-out is
+            # exactly the PR 10 deadlock shape)
+            round_now = self._round
         payload = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         out = Message(msg.get_type(), 0, 1)
         # encode-once per tier: the children share ONE re-framed payload —
         # the read-only view of the parent's frame, never a per-child copy
         out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
-        out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round)
+        out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_now)
         version = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
         if version is not None:
             out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION, version)
@@ -294,7 +298,7 @@ class EdgeAggregatorManager(DistributedManager):
             # the SAME cohort schedule as the flat server, indexed by this
             # subtree's global leaf numbers — no routing tables on the wire
             cohort = rnglib.sample_clients(
-                self._round, self.client_num_in_total, self.leaf_total
+                round_now, self.client_num_in_total, self.leaf_total
             )
             per_receiver = {
                 c: {MyMessage.MSG_ARG_KEY_CLIENT_INDEX:
@@ -306,7 +310,7 @@ class EdgeAggregatorManager(DistributedManager):
 
     # -- uplink: fold children, forward one partial --------------------------
 
-    def _guard_round(self, msg: Message, kind: str) -> bool:
+    def _guard_round(self, msg: Message, kind: str) -> bool:  # lock-held: _edge_lock
         sender = msg.get_sender_id()
         u = msg.get(MyMessage.MSG_ARG_KEY_ROUND_IDX)
         if u is not None and int(u) != self._round:
@@ -370,7 +374,7 @@ class EdgeAggregatorManager(DistributedManager):
             if done:
                 self._forward_partial()
 
-    def _forward_partial(self) -> None:
+    def _forward_partial(self) -> None:  # lock-held: _edge_lock
         partial, wsum, count = self.aggregator.partial()
         self.total_folds += int(count)
         with trace.span("tree/forward", round=self._round, folds=count,
@@ -418,16 +422,14 @@ class TreeFedAvgServerManager(FedAvgServerManager):
             TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, self._on_partial_from_tier)
 
     def _make_aggregator(self):
-        return TierAggregator(self.worker_num)
-
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
+        # the base __init__'s single construction call (fedlint:
+        # overwrite-after-super)
         if self.buffered_aggregation:
             raise ValueError(
                 "the tree root folds tier partials — there is no buffered "
                 "A/B arm (the flat server keeps the oracle)"
             )
-        self.aggregator = self._make_aggregator()
+        return TierAggregator(self.worker_num)
 
     def _on_partial_from_tier(self, msg: Message) -> None:
         from fedml_tpu.comm.status import ClientStatus

@@ -58,10 +58,10 @@ from fedml_tpu.obs import trace
 _CORRUPTIBLE = (Message.MSG_ARG_KEY_MODEL_PARAMS,
                 Message.MSG_ARG_KEY_ENCODED_UPDATE)
 
-# fedavg_distributed.MyMessage.MSG_ARG_KEY_ROUND_IDX — the authoritative
-# round index every sync/upload carries since PR 6. Spelled out here so the
-# comm layer does not import the algorithm layer.
-_ROUND_IDX_KEY = "round_idx"
+# the authoritative round index every sync/upload carries since PR 6 —
+# now defined at the comm layer (Message), so no algorithm-layer import
+# and no second spelling of the wire field
+_ROUND_IDX_KEY = Message.MSG_ARG_KEY_ROUND_IDX
 
 
 class TransientSendError(ConnectionError):
@@ -180,16 +180,16 @@ class FaultyCommManager(BaseCommunicationManager):
         self.inner = inner
         self.spec = spec
         self.rank = rank
-        self._rng = np.random.RandomState((seed * 9176 + rank * 131) % (2**31))
+        self._rng = np.random.RandomState((seed * 9176 + rank * 131) % (2**31))  # guarded-by: _rng_lock
         # independent stream for the receive side so adding downlink faults
         # never shifts an existing seeded send-side schedule
-        self._recv_rng = np.random.RandomState(
+        self._recv_rng = np.random.RandomState(  # guarded-by: _rng_lock
             (seed * 9176 + rank * 131 + 0x5EC5) % (2**31)
         )
         self._rng_lock = threading.Lock()
-        self.applied: list[tuple[str, int, int]] = []
+        self.applied: list[tuple[str, int, int]] = []  # guarded-by: _rng_lock
         self._shims: dict[object, "_RecvFaultShim"] = {}
-        self._crashed = False
+        self._crashed = False  # guarded-by: _rng_lock
 
     # -- receive side: delegation, optionally through the fault shim ---------
 
@@ -228,9 +228,13 @@ class FaultyCommManager(BaseCommunicationManager):
                           and r.random_sample() < s.delay_prob),
                 "fail": s.fail > 0 and r.random_sample() < s.fail,
             }
+            # recorded under the same lock (fedlint guarded-by): send
+            # threads and the receive shim both append to ``applied``
+            for kind, hit in plan.items():
+                if hit:
+                    self.applied.append((kind, msg_type, receiver))
         for kind, hit in plan.items():
             if hit:
-                self.applied.append((kind, msg_type, receiver))
                 trace.event("comm/fault", kind=kind, msg_type=msg_type,
                             sender=self.rank, receiver=receiver)
         return plan
@@ -242,14 +246,18 @@ class FaultyCommManager(BaseCommunicationManager):
         dead process sends nothing). Checked before anything else on the
         send path (a dead process does not get to pick which messages
         still leave)."""
-        if self._crashed:
-            raise InjectedCrash(f"rank {self.rank} is crashed (injected)")
-        cr = self.spec.crash_round
-        if cr >= 0 and round_idx is not None and int(round_idx) >= cr:
-            self._crashed = True
+        with self._rng_lock:
+            if self._crashed:
+                raise InjectedCrash(f"rank {self.rank} is crashed (injected)")
+            cr = self.spec.crash_round
+            crash_now = (cr >= 0 and round_idx is not None
+                         and int(round_idx) >= cr)
+            if crash_now:
+                self._crashed = True
+                self.applied.append(("crash", -1, -1))
+        if crash_now:
             trace.event("comm/fault", kind="crash", sender=self.rank,
                         round=int(round_idx))
-            self.applied.append(("crash", -1, -1))
             raise InjectedCrash(
                 f"rank {self.rank} crashed at round {int(round_idx)} "
                 f"(injected crash={cr})"
@@ -283,7 +291,7 @@ class FaultyCommManager(BaseCommunicationManager):
 
     @staticmethod
     def _protected(msg: Message) -> bool:
-        return bool(msg.get("finished"))
+        return bool(msg.get(Message.MSG_ARG_KEY_FINISHED))
 
     def send_message(self, msg: Message) -> None:
         self._maybe_crash(msg.get(_ROUND_IDX_KEY))
@@ -359,9 +367,13 @@ class _RecvFaultShim:
             drop = s.recv_drop > 0 and r.random_sample() < s.recv_drop
             delay = (s.recv_delay > 0 and s.recv_delay_prob > 0
                      and r.random_sample() < s.recv_delay_prob)
+            # same critical section as the draws: ``applied`` is
+            # guarded-by _rng_lock and the send side appends under it too
+            for kind, hit in (("recv_drop", drop), ("recv_delay", delay)):
+                if hit:
+                    mgr.applied.append((kind, msg_type, mgr.rank))
         for kind, hit in (("recv_drop", drop), ("recv_delay", delay)):
             if hit:
-                mgr.applied.append((kind, msg_type, mgr.rank))
                 trace.event("comm/fault", kind=kind, msg_type=msg_type,
                             sender=msg.get_sender_id(), receiver=mgr.rank)
         if drop:

@@ -39,6 +39,7 @@ def read_ip_config(path: str | Path) -> dict[int, tuple[str, int]]:
     out: dict[int, tuple[str, int]] = {}
     with open(path) as fh:
         for row in csv.reader(fh):
+            # fedlint: disable=wire-contract -- CSV header sniff ("receiver_id,ip,port"), not the wire field
             if not row or row[0].strip().startswith("receiver"):
                 continue
             rank = int(row[0])
@@ -66,8 +67,8 @@ class GRPCCommManager(BaseCommunicationManager):
         self.send_timeout = float(send_timeout)
         self._queue: deque[bytes] = deque()
         self._cv = threading.Condition()
-        self._channels: dict[int, grpc.Channel] = {}
-        self._stubs: dict[int, object] = {}
+        self._channels: dict[int, grpc.Channel] = {}  # guarded-by: _stub_lock
+        self._stubs: dict[int, object] = {}  # guarded-by: _stub_lock
         self._stub_lock = threading.Lock()
         self._running = False
 
@@ -140,6 +141,10 @@ class GRPCCommManager(BaseCommunicationManager):
         with self._cv:
             self._cv.notify_all()
         self._close_send_pool()
-        for ch in self._channels.values():
+        # snapshot under the stub lock (fedlint guarded-by): a pooled
+        # broadcast leg may still be creating stubs while we stop
+        with self._stub_lock:
+            channels = list(self._channels.values())
+        for ch in channels:
             ch.close()
         self._server.stop(grace=0.5)

@@ -30,8 +30,8 @@ class InProcessBroker:
     """Topic hub shared by all ranks of one job."""
 
     def __init__(self):
-        self._subs: dict[str, list] = {}
-        self._wills: dict[object, tuple] = {}
+        self._subs: dict[str, list] = {}  # guarded-by: _lock
+        self._wills: dict[object, tuple] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def subscribe(self, topic: str, client) -> None:

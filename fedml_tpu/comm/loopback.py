@@ -54,7 +54,7 @@ class OrderedUplinkFabric(LoopbackFabric):
         self._expected = expected
         self._type = msg_type
         self._receiver = receiver
-        self._held: dict[int, bytes] = {}
+        self._held: dict[int, bytes] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def post(self, msg: Message) -> None:

@@ -68,7 +68,16 @@ class Message:
     MSG_ARG_KEY_MODEL_PARAMS = "model_params"
     MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
     MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
-    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    # protocol-shared header fields every manager family uses: the model
+    # structure descriptor (pack_pytree), the authoritative round index a
+    # sync/upload belongs to (PR 6: clients train AS this round, so a
+    # replayed downlink leg cannot desynchronize a round counter), and the
+    # graceful-stop flag on the final fan-out. Defined at the comm layer so
+    # protocol modules (fedavg, fedgkt, splitnn, turbo, vertical, tree) and
+    # the fault injector share one spelling without importing each other.
+    MSG_ARG_KEY_MODEL_DESC = "model_desc"
+    MSG_ARG_KEY_ROUND_IDX = "round_idx"
+    MSG_ARG_KEY_FINISHED = "finished"
     # compressed-update payload (compress/codec.py EncodedUpdate): the flat
     # byte vector of all encoded planes + the recursive structure descriptor
     MSG_ARG_KEY_ENCODED_UPDATE = "encoded_update"

@@ -133,7 +133,7 @@ class OffloadCommManager(BaseCommunicationManager):
         # fan-outs exist (2 keeps a one-round-stale straggler downloadable)
         self.broadcast_generations = max(1, int(broadcast_generations))
         self._bcast_lock = threading.Lock()
-        self._bcast_gens: list[list[str]] = []
+        self._bcast_gens: list[list[str]] = []  # guarded-by: _bcast_lock
         self._resolver = _Resolver(self)
         self.inner.add_observer(self._resolver)
 

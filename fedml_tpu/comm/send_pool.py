@@ -55,8 +55,8 @@ class SendWorkerPool:
         ]
         self._threads: list[threading.Thread] = []
         self._lock = threading.Lock()
-        self._started = False
-        self._closed = False
+        self._started = False  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
 
     def _ensure_started(self) -> None:
         with self._lock:

@@ -62,8 +62,8 @@ class ClientStatusTracker:
 
     def __init__(self, expected_clients: int):
         self.expected = expected_clients
-        self._status: dict[int, str] = {}
-        self._last_seen: dict[int, float] = {}
+        self._status: dict[int, str] = {}  # guarded-by: _lock
+        self._last_seen: dict[int, float] = {}  # guarded-by: _lock
         self._lock = threading.Lock()
         self._all_online = threading.Event()
         # fleet telemetry hook (obs/registry.py FleetHealth): called as

@@ -97,7 +97,9 @@ def write_leaf_mnist_fixture(
         np.exp(rng.normal(np.log(20.0), 1.0, n_clients)).astype(int),
         min_samples, max_samples,
     )
+    # fedlint: disable=wire-contract -- LEAF's on-disk JSON schema field, not the wire key
     train_blob = {"users": [], "num_samples": [], "user_data": {}}
+    # fedlint: disable=wire-contract -- LEAF's on-disk JSON schema field, not the wire key
     test_blob = {"users": [], "num_samples": [], "user_data": {}}
     for ci in range(n_clients):
         uid = f"f_{ci:05d}"
@@ -111,6 +113,7 @@ def write_leaf_mnist_fixture(
         for blob, sl in ((train_blob, slice(n_test, None)),
                          (test_blob, slice(0, n_test))):
             blob["users"].append(uid)
+            # fedlint: disable=wire-contract -- LEAF's on-disk JSON schema field, not the wire key
             blob["num_samples"].append(int(len(y[sl])))
             blob["user_data"][uid] = {
                 "x": xr[sl].tolist(), "y": y[sl].tolist(),

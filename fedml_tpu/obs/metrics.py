@@ -90,9 +90,11 @@ class CommBytesAccountant:
         # _round_timed_out -> _complete_round) — counters need the lock or
         # an interleaved read-add-store loses straggler bytes
         self._lock = threading.Lock()
-        self.rounds: list[dict] = []
-        self._up = self._up_dense = 0
-        self._down = self._down_dense = 0
+        self.rounds: list[dict] = []  # guarded-by: _lock
+        self._up = 0  # guarded-by: _lock
+        self._up_dense = 0  # guarded-by: _lock
+        self._down = 0  # guarded-by: _lock
+        self._down_dense = 0  # guarded-by: _lock
 
     def record_uplink(self, actual: int, dense: int) -> None:
         with self._lock:

@@ -166,9 +166,9 @@ class MetricRegistry:
     def __init__(self, growth: float = 2.0):
         self._lock = threading.Lock()
         self._growth = float(growth)
-        self._counters: dict[str, float] = {}
-        self._gauges: dict[str, float] = {}
-        self._hists: dict[str, Histogram] = {}
+        self._counters: dict[str, float] = {}  # guarded-by: _lock
+        self._gauges: dict[str, float] = {}  # guarded-by: _lock
+        self._hists: dict[str, Histogram] = {}  # guarded-by: _lock
 
     def counter(self, name: str, inc: float = 1.0) -> None:
         with self._lock:
@@ -310,9 +310,9 @@ class FleetHealth:
         self._lock = threading.Lock()
         self._growth = float(growth)
         self._t0 = time.monotonic()
-        self._ranks: dict[int, dict] = {}
+        self._ranks: dict[int, dict] = {}  # guarded-by: _lock
 
-    def _rec(self, rank: int) -> dict:
+    def _rec(self, rank: int) -> dict:  # lock-held: _lock
         rec = self._ranks.get(rank)
         if rec is None:
             rec = self._ranks[rank] = {

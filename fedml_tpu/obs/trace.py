@@ -128,8 +128,8 @@ class Tracer:
         self._lock = threading.Lock()
         self._max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
                             else int(max_events))
-        self._events: "deque[dict]" = deque(maxlen=self._max_events)
-        self.dropped = 0
+        self._events: "deque[dict]" = deque(maxlen=self._max_events)  # guarded-by: _lock
+        self.dropped = 0  # guarded-by: _lock
         self._thread_ids: dict[int, int] = {}
         self._thread_names: dict[int, str] = {}
 
@@ -206,11 +206,13 @@ class Tracer:
         :data:`DROPPED_EVENT_NAME` appended to both export formats when the
         ring evicted anything — a truncated trace must say so in-band, not
         only in a log line that scrolled away."""
-        if not self.dropped:
+        with self._lock:
+            dropped = self.dropped
+        if not dropped:
             return None
         return {"name": self.DROPPED_EVENT_NAME, "ph": "C",
                 "ts": self._us(self._clock()), "tid": 0,
-                "args": {"value": float(self.dropped),
+                "args": {"value": float(dropped),
                          "max_events": self._max_events}}
 
     def thread_names(self) -> dict[int, str]:
@@ -253,8 +255,8 @@ class Tracer:
             ],
             "displayTimeUnit": "ms",
         }
-        if self.dropped:
-            payload["droppedEvents"] = self.dropped
+        if dropped is not None:
+            payload["droppedEvents"] = int(dropped["args"]["value"])
         with open(path, "w") as f:
             json.dump(payload, f)
         return path
@@ -370,12 +372,14 @@ class trace_to:
         logging.info("trace written: %s (%d events); open %s in Perfetto",
                      self.jsonl_path, len(self.tracer.events()),
                      self.chrome_path)
-        if self.tracer.dropped:
+        dropped = self.tracer._dropped_record()
+        if dropped is not None:
             logging.warning(
                 "trace ring wrapped: %d oldest events evicted past the "
                 "%d-event cap (Tracer(max_events=...) raises it; the "
                 "exports carry a %s counter record)",
-                self.tracer.dropped, self.tracer._max_events,
+                int(dropped["args"]["value"]),
+                int(dropped["args"]["max_events"]),
                 Tracer.DROPPED_EVENT_NAME,
             )
         return False

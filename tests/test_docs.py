@@ -15,6 +15,7 @@ DOCS = [
     DOCS_DIR / "PERFORMANCE.md",
     DOCS_DIR / "OBSERVABILITY.md",
     DOCS_DIR / "ROBUSTNESS.md",
+    DOCS_DIR / "STATIC_ANALYSIS.md",
 ]
 
 

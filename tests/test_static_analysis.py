@@ -1,0 +1,393 @@
+"""fedlint (fedml_tpu.analysis): per-rule firing fixtures (positive +
+non-firing negative), waiver syntax, report schema, config parsing, and
+the tier-1 zero-findings gate over the real package run in-process."""
+
+import dataclasses
+import importlib.util
+import io
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from fedml_tpu.analysis import (
+    FedlintConfig,
+    load_config,
+    make_rules,
+    render_json,
+    run_analysis,
+)
+from fedml_tpu.analysis.config import _parse_fallback
+from fedml_tpu.analysis.report import live_findings
+
+REPO = Path(__file__).parent.parent
+
+
+def lint(tmp_path, sources, select=None, config=None):
+    """Write fixture modules, run the selected rules, return (live, all,
+    waivers)."""
+    for name, src in sources.items():
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    cfg = config or FedlintConfig()
+    if select:
+        cfg = dataclasses.replace(cfg, select=tuple(select))
+    findings, waivers, _ = run_analysis(
+        [str(tmp_path)], make_rules(cfg), exclude=cfg.exclude,
+        root=str(tmp_path),
+    )
+    return live_findings(findings), findings, waivers
+
+
+# -- rule: guarded-by --------------------------------------------------------
+
+
+GUARDED_SRC = """
+    import threading
+
+    class Tally:
+        def __init__(self):
+            self._acc = {}  # guarded-by: _lock
+            self._lock = threading.Lock()
+
+        def bad(self):
+            self._acc["k"] = 1          # unguarded: fires
+
+        def good(self):
+            with self._lock:
+                self._acc["k"] = 1      # guarded: clean
+
+        def helper(self):  # lock-held: _lock
+            return len(self._acc)       # callee side of caller-holds-lock
+
+        def deferred(self):
+            with self._lock:
+                def cb():
+                    return self._acc    # closure runs later, lock NOT held
+                return cb
+    """
+
+
+def test_guarded_by_fires_and_negatives(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": GUARDED_SRC},
+                      select=["guarded-by"])
+    lines = sorted(f.line for f in live)
+    assert all(f.rule == "guarded-by" for f in live)
+    # exactly the unguarded touch and the deferred-closure touch fire;
+    # the with-block, the lock-held method, and __init__ stay clean
+    assert len(live) == 2
+    src = (tmp_path / "m.py").read_text().splitlines()
+    assert 'self._acc["k"] = 1          # unguarded' in src[lines[0] - 1]
+    assert "closure runs later" in src[lines[1] - 1]
+
+
+def test_guarded_by_inherits_across_files(tmp_path):
+    live, _, _ = lint(tmp_path, {
+        "base.py": """
+            import threading
+            class Base:
+                def __init__(self):
+                    self._state = []  # guarded-by: _lock
+                    self._lock = threading.Lock()
+                def tally(self):  # lock-held: _lock
+                    return len(self._state)
+            """,
+        "sub.py": """
+            from base import Base
+            class Sub(Base):
+                def bad(self):
+                    self._state.append(1)   # base-declared guard: fires
+                def tally(self):
+                    return 0                # override inherits lock-held
+            """,
+    }, select=["guarded-by"])
+    assert [f.path for f in live] == ["sub.py"]
+    assert "guarded by self._lock" in live[0].message
+    assert "Base" in live[0].message
+
+
+def test_guarded_by_checks_colliding_class_names(tmp_path):
+    """A class whose simple name collides with one in an earlier file must
+    still be walked — a collision can never exempt it from the gate."""
+    live, _, _ = lint(tmp_path, {
+        "a.py": """
+            class Widget:
+                def ok(self):
+                    return 1
+            """,
+        "b.py": """
+            import threading
+            class Widget:
+                def __init__(self):
+                    self._q = []  # guarded-by: _lock
+                    self._lock = threading.Lock()
+                def bad(self):
+                    self._q.append(1)
+            """,
+    }, select=["guarded-by"])
+    assert [f.path for f in live] == ["b.py"]
+
+
+# -- rule: overwrite-after-super ---------------------------------------------
+
+
+def test_overwrite_after_super_fires_and_factory_is_clean(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        class Tally:
+            pass
+
+        class Base:
+            def __init__(self):
+                self.agg = Tally()
+
+        class Overwriter(Base):
+            def __init__(self):
+                super().__init__()
+                self.agg = Tally()      # construct-then-overwrite: fires
+
+        class Hoister(Base):
+            def __init__(self):
+                self.cfg = object()     # hoisted config: clean
+                super().__init__()
+
+        class Coercer(Base):
+            def __init__(self):
+                super().__init__()
+                self.n = int(3)         # builtin coercion: not construction
+        """}, select=["overwrite-after-super"])
+    assert len(live) == 1
+    assert live[0].rule == "overwrite-after-super"
+    assert "Base.__init__" in live[0].message
+
+
+# -- rule: wire-contract -----------------------------------------------------
+
+
+def test_wire_contract_fires_and_negatives(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        class Msg:
+            MSG_ARG_KEY_GOOD = "good_key"
+            MSG_ARG_KEY_DEAD = "dead_key"       # never written: fires
+            MSG_ARG_KEY_BLIND = "blind_key"     # never read: fires
+
+        def send(msg):
+            msg.add_params(Msg.MSG_ARG_KEY_GOOD, 1)
+            msg.add_params(Msg.MSG_ARG_KEY_BLIND, 2)
+            msg.add_params("adhoc_key", 3)      # raw add_params key: fires
+
+        def recv(msg):
+            a = msg.get(Msg.MSG_ARG_KEY_GOOD)
+            b = msg.get(Msg.MSG_ARG_KEY_DEAD)
+            return a, b, "good_key"             # duplicate literal: fires
+        """}, select=["wire-contract"])
+    msgs = sorted(f.message for f in live)
+    assert len(live) == 4
+    assert any("never written" in m and "MSG_ARG_KEY_DEAD" in m for m in msgs)
+    assert any("never read" in m and "MSG_ARG_KEY_BLIND" in m for m in msgs)
+    assert any("ad-hoc wire key 'adhoc_key'" in m for m in msgs)
+    assert any("raw string 'good_key' duplicates" in m for m in msgs)
+
+
+def test_wire_contract_alias_constants_are_clean(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        class Message:
+            MSG_ARG_KEY_X = "x_key"
+
+        class MyMessage:
+            MSG_ARG_KEY_X = Message.MSG_ARG_KEY_X   # alias, not a dup
+
+        def roundtrip(msg):
+            msg.add_params(MyMessage.MSG_ARG_KEY_X, 1)
+            return msg.get(Message.MSG_ARG_KEY_X)
+        """}, select=["wire-contract"])
+    assert live == []
+
+
+# -- rule: traced-purity -----------------------------------------------------
+
+
+def test_traced_purity_fires_and_negatives(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        import time
+        import jax
+
+        @jax.jit
+        def decorated(x):
+            t = time.time()             # host call in traced body: fires
+            return x + t
+
+        def by_name(x):
+            print(x)                    # traced via jax.jit(by_name): fires
+            return x
+
+        stepped = jax.jit(by_name)
+
+        def host_side(x):
+            time.time()                 # never lowered: clean
+            print(x)
+            return x
+        """}, select=["traced-purity"])
+    assert len(live) == 2
+    assert all(f.rule == "traced-purity" for f in live)
+    assert any("time.time()" in f.message and "`decorated`" in f.message
+               for f in live)
+    assert any("print()" in f.message and "`by_name`" in f.message
+               for f in live)
+
+
+# -- rule: metric-keys -------------------------------------------------------
+
+
+def test_metric_keys_fires_and_negatives(tmp_path):
+    cfg = dataclasses.replace(FedlintConfig(),
+                              metric_modules=("obs/metrics.py",))
+    live, _, _ = lint(tmp_path, {
+        "obs/metrics.py": """
+            COMM_BYTES = "Comm/Bytes"       # defining module: clean
+            """,
+        "user.py": """
+            from obs import metrics
+
+            def record(log):
+                log(metrics.COMM_BYTES, 1)          # constant: clean
+                log("Comm/Bytes", 2)                # ad-hoc literal: fires
+                return "the Async/* totals"         # prose w/ space: clean
+            """,
+    }, select=["metric-keys"], config=cfg)
+    assert len(live) == 1
+    assert live[0].path == "user.py"
+    assert "'Comm/Bytes'" in live[0].message
+
+
+# -- waivers -----------------------------------------------------------------
+
+
+def test_justified_waiver_suppresses_but_stays_enumerable(tmp_path):
+    live, all_findings, waivers = lint(tmp_path, {"m.py": """
+        def record(log):
+            log("Comm/Adhoc")  # fedlint: disable=metric-keys -- fixture literal
+        """}, select=["metric-keys"])
+    assert live == []
+    waived = [f for f in all_findings if f.waived]
+    assert len(waived) == 1
+    assert waived[0].waiver_reason == "fixture literal"
+    assert len(waivers) == 1 and waivers[0].used
+
+
+def test_unjustified_waiver_is_itself_a_finding(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        def record(log):
+            log("Comm/Adhoc")  # fedlint: disable=metric-keys
+        """}, select=["metric-keys"])
+    # the original finding stays live AND the bare directive is flagged
+    assert sorted(f.rule for f in live) == ["metric-keys", "waiver"]
+    assert any("no justification" in f.message for f in live)
+
+
+def test_unused_waiver_is_flagged(tmp_path):
+    live, _, _ = lint(tmp_path, {"m.py": """
+        def clean():  # fedlint: disable=metric-keys -- nothing here fires
+            return 0
+        """}, select=["metric-keys"])
+    assert [f.rule for f in live] == ["waiver"]
+    assert "suppresses nothing" in live[0].message
+
+
+def test_standalone_waiver_covers_next_line(tmp_path):
+    live, all_findings, _ = lint(tmp_path, {"m.py": """
+        def record(log):
+            # fedlint: disable=metric-keys -- standalone directive form
+            log("Comm/Adhoc")
+        """}, select=["metric-keys"])
+    assert live == []
+    assert [f.waiver_reason for f in all_findings] == [
+        "standalone directive form"
+    ]
+
+
+# -- report schema / config / CLI -------------------------------------------
+
+
+def test_json_report_schema(tmp_path):
+    _, all_findings, waivers = lint(tmp_path, {"m.py": """
+        def record(log):
+            log("Comm/Adhoc")
+        """}, select=["metric-keys"])
+    doc = json.loads(render_json(all_findings, waivers, ["m.py"],
+                                 ["metric-keys"]))
+    assert doc["schema_version"] == 1
+    assert doc["rules"] == ["metric-keys"]
+    assert doc["files_scanned"] == ["m.py"]
+    assert doc["summary"] == {"findings": 1, "waived": 0, "files": 1}
+    (finding,) = doc["findings"]
+    assert set(finding) == {"rule", "path", "line", "col", "message",
+                            "waived", "waiver_reason"}
+
+
+def test_unknown_rule_selection_raises():
+    cfg = dataclasses.replace(FedlintConfig(), select=("no-such-rule",))
+    with pytest.raises(ValueError, match="no-such-rule"):
+        make_rules(cfg)
+
+
+def test_config_fallback_parser_and_repo_section():
+    section = _parse_fallback(textwrap.dedent("""
+        [tool.other]
+        paths = ["nope"]
+        [tool.fedlint]
+        # comment
+        paths = ["a", "b"]
+        select = ["guarded-by"]
+        flag = true
+        """))
+    assert section == {"paths": ["a", "b"], "select": ["guarded-by"],
+                       "flag": True}
+    cfg = load_config(REPO)
+    assert cfg.paths == ("fedml_tpu", "tools")
+    assert set(cfg.select) == {
+        "guarded-by", "overwrite-after-super", "wire-contract",
+        "traced-purity", "metric-keys",
+    }
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "fedlint_cli", REPO / "tools" / "fedlint.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_cli_exit_codes(tmp_path):
+    cli = _load_cli()
+    (tmp_path / "dirty.py").write_text(
+        'def f(log):\n    log("Comm/Adhoc")\n'
+    )
+    out = io.StringIO()
+    assert cli.run([str(tmp_path / "dirty.py")], out=out) == 1
+    assert "Comm/Adhoc" in out.getvalue()
+    (tmp_path / "clean.py").write_text("def f():\n    return 0\n")
+    assert cli.run([str(tmp_path / "clean.py")], out=io.StringIO()) == 0
+    assert cli.main(["--list-rules"]) == 0
+
+
+# -- the tier-1 gate ---------------------------------------------------------
+
+
+def test_repo_is_clean():
+    """The gate: zero live findings and zero unjustified waivers over
+    fedml_tpu/ and tools/ — every waiver carries its justification."""
+    cli = _load_cli()
+    out = io.StringIO()
+    rc = cli.run(fmt="json", out=out)
+    doc = json.loads(out.getvalue())
+    live = [f for f in doc["findings"] if not f["waived"]]
+    assert rc == 0 and live == [], live
+    assert doc["summary"]["files"] > 100  # the whole package, not a subset
+    for f in doc["findings"]:  # waived: justification is mandatory
+        assert f["waiver_reason"], f
+    for w in doc["waivers"]:
+        assert w["used"] and w["reason"], w

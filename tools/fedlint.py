@@ -1,0 +1,85 @@
+"""fedlint CLI: the repo's invariant gate (docs/STATIC_ANALYSIS.md).
+
+    python tools/fedlint.py [paths...] [--format text|json]
+                            [--select rule,rule] [--list-rules]
+
+Paths and rule selection default to the ``[tool.fedlint]`` section of
+pyproject.toml. Exit status: 0 when there are zero live findings (waived
+findings with a justification are enumerated but do not fail the gate);
+1 when any finding is live — including unjustified or unused waivers,
+which surface as rule ``waiver`` findings. Tier-1 runs this in-process
+over ``fedml_tpu/`` and ``tools/`` (tests/test_static_analysis.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(paths: list[str] | None = None, fmt: str = "text",
+        select: list[str] | None = None, root: str | None = None,
+        out=None) -> int:
+    """Programmatic entry (the tier-1 gate calls this in-process).
+    Returns the process exit code; the rendered report goes to ``out``
+    (default stdout)."""
+    import dataclasses
+
+    from fedml_tpu.analysis import (
+        load_config,
+        make_rules,
+        render_json,
+        render_text,
+        run_analysis,
+    )
+    from fedml_tpu.analysis.report import live_findings
+
+    out = out or sys.stdout
+    root = root or REPO_ROOT
+    config = load_config(root)
+    if select:
+        config = dataclasses.replace(config, select=tuple(select))
+    scan_paths = list(paths) if paths else [
+        os.path.join(root, p) for p in config.paths
+    ]
+    rules = make_rules(config)
+    findings, waivers, scanned = run_analysis(
+        scan_paths, rules, exclude=config.exclude, root=root,
+    )
+    renderer = render_json if fmt == "json" else render_text
+    print(renderer(findings, waivers, scanned, [r.name for r in rules]),
+          file=out)
+    return 1 if live_findings(findings) else 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="AST-based invariant checker (see docs/STATIC_ANALYSIS.md)"
+    )
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to scan (default: "
+                             "[tool.fedlint] paths)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--select",
+                        help="comma-separated rule names (default: "
+                             "[tool.fedlint] select)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule registry and exit")
+    args = parser.parse_args(argv)
+    if args.list_rules:
+        from fedml_tpu.analysis import all_rules
+
+        for name, cls in sorted(all_rules().items()):
+            print(f"{name}: {cls.description}")
+        return 0
+    select = [s.strip() for s in args.select.split(",")] if args.select else None
+    return run(args.paths or None, fmt=args.format, select=select)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

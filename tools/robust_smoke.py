@@ -37,6 +37,7 @@ def main(argv=None) -> int:
         run_distributed_fedavg,
     )
     from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+    from fedml_tpu.obs import metrics as metricslib
     from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
     from fedml_tpu.core.trainer import ClientTrainer
     from fedml_tpu.data.poison import Trigger, poison_clients
@@ -100,7 +101,8 @@ def main(argv=None) -> int:
         # defense actually fired (poisoned deltas are the ones clipping)
         assert stream_stats["rounds"] == oracle_stats["rounds"]
         assert len(stream_stats["rounds"]) == ROUNDS
-        assert any(r["Robust/ClipFraction"] > 0 for r in stream_stats["rounds"])
+        assert any(r[metricslib.ROBUST_CLIP_FRACTION] > 0
+                   for r in stream_stats["rounds"])
 
     print(
         f"robust smoke OK: {ROUNDS} rounds x {WORKERS} workers "

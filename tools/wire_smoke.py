@@ -52,7 +52,7 @@ def main(argv=None) -> int:
         def __init__(self, world_size: int, expected: int):
             super().__init__(world_size)
             self._expected = expected
-            self._held: dict[int, bytes] = {}
+            self._held: dict[int, bytes] = {}  # guarded-by: _lock
             self._lock = threading.Lock()
 
         def post(self, msg: Message) -> None:
