@@ -673,6 +673,144 @@ def bench_fleet_overhead(n_rounds: int = 6):
     }
 
 
+POP_CLIENTS = 128  # the population probe's Zipf cohort size
+POP_SPEC = "speed=lognormal:0,0.6;dropout=0.1"
+POP_WIRE_SPEC = "speed=lognormal:0,0.6;jitter=uniform:0.01,0.35"
+
+
+def bench_population_ab(n_rounds: int = 3):
+    """Heterogeneous-population A/B (docs/PERFORMANCE.md "Heterogeneous
+    populations"), two arms sharing one population realization:
+
+    1. **Packed-lane win preserved under heterogeneity**: the Zipf-data
+       cohort of bench_pack_ab, but with a lognormal speed model truncating
+       budgets and 10% mid-round dropout — the packer bins by PREDICTED
+       steps and re-packs dropped lanes into overflow passes. Reports
+       packed vs padded rounds/sec through FedSim.run() (bit-identical
+       results, tools/population_smoke.py).
+    2. **Sync vs async time-to-accuracy under the same trace**: a loopback
+       run whose per-rank upload delays come from the population's
+       jitter/speed draws (population/wire.py) — the sync barrier waits for
+       the population's stragglers every round, the buffered-async server
+       emits on its buffer goal. Reports wall seconds and final pooled
+       accuracy per arm.
+    Returns probe metrics for ``extra``."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.core import scan as scanlib
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.population import population_fault_specs
+    from fedml_tpu.sim.cohort import FederatedArrays, batch_array
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    # -- arm 1: packed vs padded under churn (sim) -------------------------
+    C, B, F, K = POP_CLIENTS, 16, 64, 16
+    sizes = np.maximum((1024 / np.arange(1, C + 1) ** 1.1), 1).astype(int)
+    rng = np.random.RandomState(0)
+    n = int(sizes.sum())
+    x = rng.rand(n, F).astype(np.float32)
+    y = rng.randint(0, K, n).astype(np.int32)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    part = {i: np.arange(bounds[i], bounds[i + 1]) for i in range(C)}
+    train = FederatedArrays({"x": x, "y": y}, part)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=K),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=n_rounds, epochs=1, frequency_of_the_test=10_000,
+        shuffle_each_round=False, seed=0, block_dispatch=False,
+        population=POP_SPEC,
+    )
+
+    def rps(pack_lanes):
+        sim = FedSim(trainer, train, None,
+                     dataclasses.replace(cfg, pack_lanes=pack_lanes))
+        sim.run()  # compile + warm
+        t0 = time.perf_counter()
+        _, hist = sim.run()
+        return len(hist) / (time.perf_counter() - t0), sim
+
+    packed_rps, packed_sim = rps(PACK_LANES)
+    padded_rps, _ = rps(0)
+    stats = packed_sim.pack_round_stats(0)
+    out = {
+        "pop_pack_clients": C,
+        "pop_spec": POP_SPEC,
+        "pop_pack_rounds_per_sec": round(packed_rps, 3),
+        "pop_padded_rounds_per_sec": round(padded_rps, 3),
+        "pop_pack_speedup": round(packed_rps / padded_rps, 2),
+        "pop_pack_n_passes": stats["n_passes"],
+        "pop_padding_step_frac_packed": round(
+            1.0 - stats["total_steps"] / stats["capacity"], 4
+        ),
+    }
+
+    # -- arm 2: sync vs async time-to-accuracy under the same trace --------
+    workers = 8
+    wtrain, _ = gaussian_blobs(n_clients=workers, samples_per_client=48,
+                               num_classes=4, seed=0)
+    wtrainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    adapter = population_fault_specs(POP_WIRE_SPEC, workers, seed=0)
+    pooled = batch_array(
+        {k: np.concatenate([v[wtrain.partition[i]] for i in range(workers)])
+         for k, v in wtrain.arrays.items()},
+        64,
+    )
+    pooled = jax.tree.map(jnp.asarray, pooled)
+
+    @jax.jit
+    def acc_of(variables):
+        def step(c, b):
+            return c, wtrainer.eval_batch(variables, b)
+
+        _, m = scanlib.scan(step, 0, pooled)
+        s = jax.tree.map(lambda v: jnp.sum(v, 0), m)
+        return s["test_correct"] / jnp.maximum(s["test_total"], 1.0)
+
+    def timed_arm(**kw):
+        run_distributed_fedavg_loopback(  # warm: compile + thread spinup
+            wtrainer, wtrain, worker_num=workers, round_num=1, batch_size=8,
+            **{k: v for k, v in kw.items() if k != "population"},
+        )
+        t0 = time.perf_counter()
+        final = run_distributed_fedavg_loopback(
+            wtrainer, wtrain, worker_num=workers, round_num=n_rounds,
+            batch_size=8, population=adapter, **kw,
+        )
+        return time.perf_counter() - t0, float(acc_of(final))
+
+    sync_s, sync_acc = timed_arm()
+    async_s, async_acc = timed_arm(
+        server_mode="async", buffer_goal=workers // 2,
+    )
+    out.update({
+        "pop_wire_spec": POP_WIRE_SPEC,
+        "pop_wire_workers": workers,
+        "pop_sync_wall_s": round(sync_s, 3),
+        "pop_sync_acc": round(sync_acc, 4),
+        "pop_async_wall_s": round(async_s, 3),
+        "pop_async_acc": round(async_acc, 4),
+        "pop_async_speedup": round(sync_s / async_s, 2),
+    })
+    return out
+
+
 def bench_async_ab(n_rounds: int = 3):
     """Barrier-free server A/B (docs/PERFORMANCE.md "Barrier-free
     aggregation"): loopback uploads/sec and models-emitted/sec for the
@@ -1230,6 +1368,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_async_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["async_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_population_probe"
+    try:
+        pipeline_extra.update(bench_population_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["population_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_fleet_probe"
     try:

@@ -21,6 +21,23 @@ from fedml_tpu.core import tree as treelib
 Pytree = Any
 
 
+class EmptyRoundError(RuntimeError):
+    """A round closed (or staged) with NOTHING to aggregate.
+
+    Wire path (fedavg_distributed): ``aggregate()`` was asked to close a
+    round with ZERO uploads — every worker (stragglers included) was
+    dropped by the elastic round timeout. The server keeps the previous
+    global model in that case (``_round_timed_out`` re-arms instead of
+    closing); calling aggregate directly on an empty tally is a protocol
+    bug, reported loudly instead of the legacy ``IndexError``/NaN.
+
+    Sim engine: a population's availability churn left the round's cohort
+    empty (or every sampled member dropped mid-round) — raised at staging
+    with the round named, mirroring the wire path's semantics instead of
+    surfacing as a downstream shape/NaN error. Defined here (the light
+    shared layer) so both paths raise ONE class."""
+
+
 @dataclasses.dataclass(frozen=True)
 class Aggregator:
     """``init_state(global_variables) -> state`` and

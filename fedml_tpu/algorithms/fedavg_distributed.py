@@ -50,13 +50,11 @@ class MyMessage:
     MSG_ARG_KEY_ROUND_IDX = Message.MSG_ARG_KEY_ROUND_IDX
 
 
-class EmptyRoundError(RuntimeError):
-    """``aggregate()`` was asked to close a round with ZERO uploads —
-    every worker (stragglers included) was dropped by the elastic round
-    timeout. The server keeps the previous global model in that case
-    (``_round_timed_out`` re-arms instead of closing); calling aggregate
-    directly on an empty tally is a protocol bug, reported loudly instead
-    of the legacy ``IndexError``/NaN."""
+# canonical definition moved to the light shared layer (algorithms/base.py)
+# so the sim engine raises the SAME class on population-churn-empty rounds;
+# re-exported here — every existing `from ...fedavg_distributed import
+# EmptyRoundError` site keeps working
+from fedml_tpu.algorithms.base import EmptyRoundError  # noqa: E402,F401
 
 
 class FedAvgDistAggregator:
@@ -826,6 +824,10 @@ class FedAvgClientManager(ClientManager):
         # registry installed for unrelated gauges must never change what
         # goes on the wire
         self.fleet_telemetry = False
+        # per-rank population profile (population/wire.py adapter; set by
+        # the runner under population=): feeds the predicted-vs-actual
+        # step gauges piggybacked when fleet telemetry is on
+        self.population_profile = None
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
@@ -906,11 +908,34 @@ class FedAvgClientManager(ClientManager):
             # header-only JSON scalars (never payload); "retries" is this
             # manager's cumulative count as of the PREVIOUS send — the
             # current send's re-attempts land on the next round's report
-            out.add_params(Message.MSG_ARG_KEY_TELEMETRY, {
+            report = {
                 "step_ms": round(step_ms, 3),
                 "sent_at": time.time(),
                 "retries": self.comm_retries,
-            })
+            }
+            prof = self.population_profile
+            if prof is not None:
+                # population churn gauges (docs/OBSERVABILITY.md "Fleet
+                # telemetry"): cumulative predicted-vs-actual step totals
+                # (predicted = the speed model's forecast; actual = what
+                # this client really ran) plus the uploads its own fault
+                # wrapper dropped — counts ride the report's "counts"
+                # field, which the server folds into per-rank gauges
+                S = next(iter(batches.values())).shape[0]
+                actual = int(self.trainer.epochs * S)
+                predicted = int(np.ceil(prof["predicted_frac"] * actual))
+                self._pop_predicted = getattr(
+                    self, "_pop_predicted", 0) + max(predicted, 1)
+                self._pop_actual = getattr(self, "_pop_actual", 0) + actual
+                counts = {
+                    "pop_predicted_steps": self._pop_predicted,
+                    "pop_actual_steps": self._pop_actual,
+                }
+                applied = getattr(self.comm, "applied_counts", None)
+                if applied is not None:
+                    counts["pop_dropped_uploads"] = applied().get("drop", 0)
+                report["counts"] = counts
+            out.add_params(Message.MSG_ARG_KEY_TELEMETRY, report)
         self.send_message(out)
 
 
@@ -1131,6 +1156,7 @@ def run_distributed_fedavg(
     robust_stats: dict | None = None,
     fault_specs=None,
     fault_seed: int = 0,
+    population=None,
     retry_policy=None,
     heartbeat_interval: float | None = None,
     heartbeat_timeout: float | None = None,
@@ -1228,6 +1254,53 @@ def run_distributed_fedavg(
             "robust_config= does not compose with custom manager classes "
             "(e.g. is_mobile's JSON wire format)"
         )
+    if population is not None:
+        # heterogeneous-population wire adapter (population/wire.py,
+        # docs/PERFORMANCE.md "Heterogeneous populations"): per-rank upload
+        # delays/drops drawn from the population distributions, scheduled
+        # through the same seeded fault machinery as fault_specs
+        from fedml_tpu.population.wire import (
+            PopulationWireAdapter,
+            population_fault_specs,
+        )
+
+        if not isinstance(population, PopulationWireAdapter):
+            population = population_fault_specs(
+                population, worker_num, seed=fault_seed or seed
+            )
+        elif population.worker_num != worker_num:
+            raise ValueError(
+                f"population adapter was built for "
+                f"{population.worker_num} workers but this run has "
+                f"{worker_num} — the uncovered ranks would silently run "
+                "un-churned (the trace loader rejects the analogous "
+                "num_clients mismatch for the same reason)"
+            )
+        if fault_specs is not None and population.active:
+            raise ValueError(
+                "population= and fault_specs= both drive the wire fault "
+                "injector — one seeded schedule would silently shift the "
+                "other; configure churn in exactly one place"
+            )
+        if population.drops_uploads:
+            if server_mode != "sync":
+                raise ValueError(
+                    "the population drops uploads but the async server "
+                    "has no timeout/readmission path for a silently lost "
+                    "upload — the dropped rank never receives another "
+                    "downlink and strands forever; run server_mode='sync' "
+                    "with round_timeout=, or model the churn as delays "
+                    "(jitter) instead of drops"
+                )
+            if round_timeout is None:
+                raise ValueError(
+                    "the population drops uploads but the sync round "
+                    "barrier has no round_timeout — the first dropped "
+                    "upload would wedge the round forever; set "
+                    "round_timeout="
+                )
+        if population.active:
+            fault_specs = population.fault_specs
     if fault_specs is not None:
         from fedml_tpu.comm.faults import wrap_make_comm
 
@@ -1382,6 +1455,12 @@ def run_distributed_fedavg(
     if fleet_stats is not None:
         for c in clients:
             c.fleet_telemetry = True
+    if population is not None:
+        # per-rank population profile (speed / predicted step fraction):
+        # fleet-telemetry-armed clients piggyback predicted-vs-actual step
+        # gauges from it so fleet_report renders the churn
+        for c in clients:
+            c.population_profile = population.profiles.get(c.rank)
 
     from fedml_tpu.comm.retry import retry_stats
 

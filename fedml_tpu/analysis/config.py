@@ -38,6 +38,16 @@ class FedlintConfig:
         "time.time", "np.random.*", "numpy.random.*", "print",
         "datetime.now", "datetime.datetime.now",
     )
+    # traced-purity, module-wide arm: "<path-prefix>:<pattern>" entries ban
+    # a call pattern EVERYWHERE in matching modules (not just traced
+    # functions). The population subsystem's replay determinism rests on
+    # every draw flowing through its seeded rng (population/prng.py), so
+    # np.random.* is banned module-wide there — machine-checked instead of
+    # review-checked.
+    banned_module_calls: tuple[str, ...] = (
+        "fedml_tpu/population/:np.random.*",
+        "fedml_tpu/population/:numpy.random.*",
+    )
 
 
 def _parse_fallback(text: str) -> dict:
@@ -108,4 +118,6 @@ def load_config(start: str | Path | None = None) -> FedlintConfig:
         metric_modules=tup("metric-modules", defaults.metric_modules),
         banned_traced_calls=tup("banned-traced-calls",
                                 defaults.banned_traced_calls),
+        banned_module_calls=tup("banned-module-calls",
+                                defaults.banned_module_calls),
     )

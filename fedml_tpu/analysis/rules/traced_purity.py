@@ -56,18 +56,33 @@ class TracedPurityRule(Rule):
     name = "traced-purity"
     description = ("banned host calls (time.time, np.random.*, print, "
                    "datetime.now) inside jit/pjit/shard_map-lowered "
-                   "functions")
+                   "functions; banned-module-calls entries ban a pattern "
+                   "module-wide (e.g. np.random.* anywhere under "
+                   "fedml_tpu/population/ — replay determinism)")
 
     def __init__(self, config):
         self.config = config
         self.banned = tuple(config.banned_traced_calls)
+        # "<path-prefix>:<pattern>" module-wide bans (config.py)
+        self.module_banned: list[tuple[str, str]] = []
+        for entry in getattr(config, "banned_module_calls", ()):
+            prefix, sep, pattern = entry.partition(":")
+            if not sep or not prefix or not pattern:
+                raise ValueError(
+                    f"banned-module-calls entry {entry!r}: expected "
+                    "'<path-prefix>:<call-pattern>'"
+                )
+            self.module_banned.append((prefix, pattern))
+
+    @staticmethod
+    def _match(dotted: str, pattern: str) -> bool:
+        if pattern.endswith(".*"):
+            return dotted.startswith(pattern[:-1])
+        return dotted == pattern
 
     def _banned_match(self, dotted: str) -> str | None:
         for pattern in self.banned:
-            if pattern.endswith(".*"):
-                if dotted.startswith(pattern[:-1]):
-                    return pattern
-            elif dotted == pattern:
+            if self._match(dotted, pattern):
                 return pattern
         return None
 
@@ -118,4 +133,35 @@ class TracedPurityRule(Rule):
                 scan(fn_def, name)
         for lam, via in lambdas:
             scan(lam, f"<lambda via {via}>")
+
+        # module-wide bans: in files under a configured path prefix, the
+        # banned pattern is illegal at ANY scope, not just traced bodies —
+        # the population subsystem's replay-determinism contract (every
+        # draw through its seeded rng, population/prng.py)
+        module_patterns = [
+            pat for prefix, pat in self.module_banned
+            if file.path.replace("\\", "/").startswith(prefix)
+        ]
+        if module_patterns:
+            seen = {(f.line, f.col) for f in findings}
+            for sub in ast.walk(file.tree):
+                if not isinstance(sub, ast.Call):
+                    continue
+                dotted = _dotted(sub.func)
+                if dotted is None:
+                    continue
+                for pattern in module_patterns:
+                    if not self._match(dotted, pattern):
+                        continue
+                    if (sub.lineno, sub.col_offset) in seen:
+                        break
+                    findings.append(Finding(
+                        self.name, file.path, sub.lineno, sub.col_offset,
+                        f"call {dotted}() matches pattern {pattern!r} "
+                        f"banned module-wide under this path "
+                        "(banned-module-calls) — draws here must flow "
+                        "through the subsystem's seeded rng so trace "
+                        "replay stays deterministic",
+                    ))
+                    break
         return findings

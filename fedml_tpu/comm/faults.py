@@ -188,6 +188,9 @@ class FaultyCommManager(BaseCommunicationManager):
         )
         self._rng_lock = threading.Lock()
         self.applied: list[tuple[str, int, int]] = []  # guarded-by: _rng_lock
+        # per-kind totals maintained at append time so applied_counts()
+        # never rescans the ledger (telemetry reads it every round)
+        self._applied_counts: dict[str, int] = {}  # guarded-by: _rng_lock
         self._shims: dict[object, "_RecvFaultShim"] = {}
         self._crashed = False  # guarded-by: _rng_lock
 
@@ -233,11 +236,22 @@ class FaultyCommManager(BaseCommunicationManager):
             for kind, hit in plan.items():
                 if hit:
                     self.applied.append((kind, msg_type, receiver))
+                    self._applied_counts[kind] = (
+                        self._applied_counts.get(kind, 0) + 1
+                    )
         for kind, hit in plan.items():
             if hit:
                 trace.event("comm/fault", kind=kind, msg_type=msg_type,
                             sender=self.rank, receiver=receiver)
         return plan
+
+    def applied_counts(self) -> dict:
+        """Per-kind totals of the faults applied so far (a consistent
+        snapshot taken under the ledger's lock; maintained incrementally
+        at append time, O(kinds) per call) — the population adapter's
+        clients report their own dropped-upload count from this."""
+        with self._rng_lock:
+            return dict(self._applied_counts)
 
     def _maybe_crash(self, round_idx) -> None:
         """``crash=r``: die on the first send touching round >= r, and stay
@@ -255,6 +269,9 @@ class FaultyCommManager(BaseCommunicationManager):
             if crash_now:
                 self._crashed = True
                 self.applied.append(("crash", -1, -1))
+                self._applied_counts["crash"] = (
+                    self._applied_counts.get("crash", 0) + 1
+                )
         if crash_now:
             trace.event("comm/fault", kind="crash", sender=self.rank,
                         round=int(round_idx))
@@ -372,6 +389,9 @@ class _RecvFaultShim:
             for kind, hit in (("recv_drop", drop), ("recv_delay", delay)):
                 if hit:
                     mgr.applied.append((kind, msg_type, mgr.rank))
+                    mgr._applied_counts[kind] = (
+                        mgr._applied_counts.get(kind, 0) + 1
+                    )
         for kind, hit in (("recv_drop", drop), ("recv_delay", delay)):
             if hit:
                 trace.event("comm/fault", kind=kind, msg_type=msg_type,

@@ -65,6 +65,13 @@ def run_rounds(sim, cfg, metrics_out: str, round_sleep: float = 0.0,
         # set, mesh geometry, and lowering mode next to the run so a
         # report reader can tell which parallelism produced the curve
         logging.info("shard_summary: %s", shard)
+    pop = getattr(sim, "population_summary", lambda: {})()
+    if pop:
+        # heterogeneous population (SimConfig.population): name the spec/
+        # trace realization up front — a curve trained under churned
+        # cohorts and truncated budgets must never be mistaken for an
+        # idealized-population run
+        logging.info("population: %s", pop)
     defense = getattr(sim, "defense_summary", lambda: {})()
     if defense:
         # robust aggregation (docs/ROBUSTNESS.md): name the active defense

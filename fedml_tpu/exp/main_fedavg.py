@@ -168,6 +168,13 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "lets the server tell SLOW from dead before "
                              "the round timeout and enables readmission of "
                              "excluded workers that reappear. 0 = off")
+    # heterogeneous population model (fedml_tpu/population,
+    # docs/PERFORMANCE.md "Heterogeneous populations"): sim backend drives
+    # cohorts/budgets/dropout in-engine; message-passing backends map the
+    # spec onto per-rank upload delays/drops via the fault machinery
+    from fedml_tpu.population import add_cli_flags as add_population_cli_flags
+
+    add_population_cli_flags(parser)
     # update compression (fedml_tpu/compress, docs/COMPRESSION.md)
     parser.add_argument("--compressor", type=str, default="none",
                         help="client->server update codec: none | bf16 | "
@@ -441,6 +448,18 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     if getattr(args, "fault_spec", None):
         robust_kwargs["fault_specs"] = args.fault_spec
         robust_kwargs["fault_seed"] = cfg.seed
+    pop_kwargs: dict = {}
+    if getattr(args, "population", None):
+        # population wire adapter (population/wire.py): the spec's
+        # distributions become per-rank upload delays/drops; profile
+        # gauges ride fleet telemetry when --fleet_stats is on
+        from fedml_tpu.population import population_fault_specs
+
+        pop_seed = getattr(args, "population_seed", None)
+        pop_kwargs["population"] = population_fault_specs(
+            args.population, cfg.client_num_per_round,
+            seed=cfg.seed if pop_seed is None else pop_seed,
+        )
     ft_kwargs: dict = {}
     if getattr(args, "send_retries", 0):
         from fedml_tpu.comm.retry import RetryPolicy
@@ -540,6 +559,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             **ft_kwargs,
             **mode_kwargs,
             **fleet_kwargs,
+            **pop_kwargs,
         )
     if comm_stats.get("totals"):
         logging.info("bytes on wire: %s", comm_stats["totals"])
@@ -602,6 +622,19 @@ def _run(args) -> list[dict]:
         raise NotImplementedError(
             "--fault_spec injects wire faults — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
+        )
+    if getattr(args, "population_trace", None) and args.backend != "sim":
+        raise NotImplementedError(
+            "--population_trace replays recorded sim cohorts/step budgets/"
+            "dropouts; the message-passing backends take the generative "
+            "--population spec (per-rank delay/drop adapter) — use "
+            "--backend sim"
+        )
+    if getattr(args, "population", None) and getattr(args, "fault_spec", None):
+        raise NotImplementedError(
+            "--population and --fault_spec both drive the seeded wire "
+            "fault injector — one schedule would silently shift the "
+            "other; pick one"
         )
     if getattr(args, "fleet_stats", None) and args.backend == "sim":
         raise NotImplementedError(
@@ -669,6 +702,7 @@ def _run(args) -> list[dict]:
         unwired = [
             flag for flag, val in [
                 ("--fault_spec", getattr(args, "fault_spec", None)),
+                ("--population", getattr(args, "population", None)),
                 ("--send_retries", getattr(args, "send_retries", 0)),
                 ("--heartbeat_interval",
                  getattr(args, "heartbeat_interval", 0.0)),
@@ -737,6 +771,10 @@ def _run(args) -> list[dict]:
                         else args.pipeline_depth),
         pack_lanes=getattr(args, "pack_lanes", 0),
         pack_capacity_factor=getattr(args, "pack_capacity_factor", 1.25),
+        population=(getattr(args, "population", None)
+                    if args.backend == "sim" else None),
+        population_trace=getattr(args, "population_trace", None),
+        population_seed=getattr(args, "population_seed", None),
         mesh_shape=parse_mesh_shape(getattr(args, "mesh_shape", None)),
         shard_rules=getattr(args, "shard_rules", None),
         compressor=getattr(args, "compressor", "none"),

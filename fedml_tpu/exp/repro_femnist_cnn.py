@@ -39,6 +39,7 @@ def _run(args) -> dict:
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
     from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
+    from fedml_tpu.population import sim_config_fields as population_fields
 
     logging_config(0)
     data_dir = Path(args.data_dir)
@@ -73,6 +74,7 @@ def _run(args) -> dict:
         pack_lanes=args.pack_lanes,
         pack_capacity_factor=args.pack_capacity_factor,
         **robust_fields(args),
+        **population_fields(args),
     )
     sim = FedSim(trainer, ds.train, ds.test_arrays, cfg)
 
@@ -193,7 +195,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
     add_trace_cli_flag(parser)
+    from fedml_tpu.population import add_cli_flags as add_population_cli_flags
+
     add_robust_cli_flags(parser)
+    add_population_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--metrics_out", type=str, default="repro_femnist_metrics.jsonl")
     parser.add_argument("--out", type=str, default="REPRO.md")

@@ -53,6 +53,7 @@ def _run(args) -> dict:
     from fedml_tpu.parallel.mesh import parse_mesh_shape
     from fedml_tpu.sim.engine import FedSim, SimConfig
     from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
+    from fedml_tpu.population import sim_config_fields as population_fields
 
     logging_config(0)
     data_dir = Path(args.data_dir)
@@ -133,6 +134,7 @@ def _run(args) -> dict:
         mesh_shape=parse_mesh_shape(args.mesh_shape),
         shard_rules=args.shard_rules or None,
         **robust_fields(args),
+        **population_fields(args),
         # THE row's systems point: population >> cohort. Keep the dataset
         # host-side; each round stages only its 50-client cohort.
         stage_on_device=False,
@@ -291,7 +293,10 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "over the mesh's model axis (e.g. "
                              "transformer_fsdp); unset = unsharded")
     add_trace_cli_flag(parser)
+    from fedml_tpu.population import add_cli_flags as add_population_cli_flags
+
     add_robust_cli_flags(parser)
+    add_population_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--train_eval_samples", type=int, default=50_000,
                         help="cap the pooled-train eval subset (None/0 = "

@@ -30,6 +30,7 @@ def _run(args) -> dict:
     from fedml_tpu.obs.metrics import logging_config
     from fedml_tpu.sim.engine import FedSim, SimConfig
     from fedml_tpu.algorithms.robust import sim_config_fields as robust_fields
+    from fedml_tpu.population import sim_config_fields as population_fields
 
     logging_config(0)
     results = {}
@@ -50,6 +51,7 @@ def _run(args) -> dict:
             pack_lanes=args.pack_lanes,
             pack_capacity_factor=args.pack_capacity_factor,
             **robust_fields(args),
+            **population_fields(args),
         )
         _, hist = FedSim(trainer, train, test, cfg).run()
         evals = [(h["round"], h["Test/Acc"]) for h in hist if "Test/Acc" in h]
@@ -131,8 +133,11 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="lane-length head room over the expected "
                              "per-shard cohort load (overflow spills to an "
                              "extra sequential pass)")
+    from fedml_tpu.population import add_cli_flags as add_population_cli_flags
+
     add_trace_cli_flag(parser)
     add_robust_cli_flags(parser)
+    add_population_cli_flags(parser)
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--size_dist", type=str, default="lognormal",
                         choices=["lognormal", "uniform"],

@@ -121,11 +121,23 @@ def cohort_index_map(
     size.
     """
     flat, offsets, sizes, lookup = data.index_csr()
+    # negative client ids are EMPTY cohort slots (the population model's
+    # availability padding, population/model.py RoundView): zero samples,
+    # all-(-1) index rows, zero weight — the same shape-stable padding
+    # convention the mesh pad already uses, so churned cohorts never change
+    # compiled shapes
+    ids = np.asarray(client_ids)
+    empty = ids < 0
     if lookup is None:
-        rows = np.asarray(client_ids, dtype=np.intp)
+        rows = np.where(empty, 0, ids).astype(np.intp)
     else:
-        rows = np.asarray([lookup[int(c)] for c in client_ids], dtype=np.intp)
+        rows = np.asarray(
+            [0 if e else lookup[int(c)] for c, e in zip(ids, empty)],
+            dtype=np.intp,
+        )
     sz = sizes[rows]
+    if empty.any():
+        sz = np.where(empty, 0, sz)
     if steps is None:
         steps = steps_per_epoch(int(sz.max()), batch_size)
     slots = steps * batch_size
@@ -177,13 +189,18 @@ def _cohort_index_map_loop(
     baseline (``host_stage_ms_loop``). Shuffle draws differ by construction
     (per-client ``permutation`` calls vs one block draw), so bit-exact
     comparisons use ``rng=None``."""
-    sizes = np.asarray([len(data.partition[int(c)]) for c in client_ids])
+    sizes = np.asarray([
+        0 if int(c) < 0 else len(data.partition[int(c)])
+        for c in client_ids
+    ])
     if steps is None:
         steps = steps_per_epoch(int(sizes.max()), batch_size)
     slots = steps * batch_size
     C = len(client_ids)
     idx = np.full((C, slots), -1, np.int32)
     for ci, cid in enumerate(client_ids):
+        if int(cid) < 0:  # empty slot (population availability padding)
+            continue
         sel = data.partition[int(cid)]
         if rng is not None:
             sel = rng.permutation(sel)
@@ -310,6 +327,41 @@ def executed_steps(
     return np.minimum(np.maximum(data_steps, 0)[:, None], budget)
 
 
+def _assign_lanes(bin_totals: np.ndarray, lanes_per_shard: int, s_lane: int,
+                  n_shards: int) -> list:
+    """The greedy-LPT lane assignment shared by the main packing and the
+    dropped-client re-pack: ``assign[p][lane] = clients`` (placement order)
+    for pass p. Clients with a zero total are skipped; a client that fits
+    no lane of the current pass spills to a fresh pass."""
+    c_local = len(bin_totals) // n_shards
+    L = lanes_per_shard * n_shards
+    assign: list[list[list[int]]] = []
+    for shard in range(n_shards):
+        slots = np.arange(shard * c_local, (shard + 1) * c_local)
+        order = slots[np.argsort(-bin_totals[slots], kind="stable")]
+        pending = [int(s) for s in order if bin_totals[s] > 0]
+        p = 0
+        while pending:
+            while len(assign) <= p:
+                assign.append([[] for _ in range(L)])
+            loads = np.zeros(lanes_per_shard, np.int64)
+            lane_clients: list[list[int]] = [[] for _ in range(lanes_per_shard)]
+            nxt: list[int] = []
+            for s in pending:
+                lane = int(np.argmin(loads))
+                # the least-loaded lane not fitting means NO lane fits
+                if loads[lane] + bin_totals[s] <= s_lane:
+                    loads[lane] += bin_totals[s]
+                    lane_clients[lane].append(s)
+                else:
+                    nxt.append(s)
+            for li, clients in enumerate(lane_clients):
+                assign[p][shard * lanes_per_shard + li] = clients
+            pending = nxt
+            p += 1
+    return assign
+
+
 def pack_cohort(
     num_steps: np.ndarray,
     data_steps: np.ndarray,
@@ -318,6 +370,7 @@ def pack_cohort(
     lanes_per_shard: int,
     s_lane: int,
     n_shards: int = 1,
+    predicted_steps: np.ndarray | None = None,
 ) -> PackPlan:
     """Greedy-LPT bin packing of the cohort's step streams into lanes.
 
@@ -330,7 +383,19 @@ def pack_cohort(
     onto the least-loaded lane that still fits; clients that fit no lane of
     the current pass spill to a fresh pass (same shapes, extra sequential
     dispatch). Pure numpy, O(total executed steps) like the CSR staging
-    machinery."""
+    machinery.
+
+    ``predicted_steps`` (docs/PERFORMANCE.md "Heterogeneous populations"):
+    the scheduler's per-client step forecast — lane ORDERING and fit
+    decisions bin by the predicted executed totals (the planner cannot know
+    who will drop mid-round), while placement emits the ACTUAL streams.
+    Clients whose actual stream came up short (mid-round dropout truncated
+    their budget: ``num_steps < predicted_steps``) are pulled out of their
+    predicted lane and RE-PACKED by their actual totals into dedicated
+    overflow passes appended after the main ones — every client's executed
+    stream is still placed exactly once (tests/test_population.py holds the
+    invariant). ``None`` keeps the original actual-steps binning
+    bit-identically."""
     num_steps = np.asarray(num_steps, np.int64)
     C = len(num_steps)
     if C % n_shards:
@@ -340,38 +405,49 @@ def pack_cohort(
     E = int(epochs)
     per_epoch = executed_steps(num_steps, data_steps, S, E)
     totals = per_epoch.sum(axis=1)
-    if (totals > s_lane).any():
-        bad = int(np.argmax(totals))
+    if predicted_steps is None:
+        bin_totals = totals
+    else:
+        predicted_steps = np.asarray(predicted_steps, np.int64)
+        if (predicted_steps < num_steps).any():
+            bad = int(np.argmax(predicted_steps < num_steps))
+            raise ValueError(
+                f"cohort slot {bad}: predicted_steps "
+                f"{int(predicted_steps[bad])} < actual num_steps "
+                f"{int(num_steps[bad])} — dropout only ever truncates a "
+                "budget, a larger actual means the prediction wiring is "
+                "wrong"
+            )
+        bin_totals = executed_steps(
+            predicted_steps, data_steps, S, E
+        ).sum(axis=1)
+    if (bin_totals > s_lane).any():
+        bad = int(np.argmax(bin_totals))
         raise ValueError(
-            f"cohort slot {bad} needs {int(totals[bad])} steps but lanes are "
-            f"{s_lane} long — size s_lane to the population max"
+            f"cohort slot {bad} needs {int(bin_totals[bad])} steps but lanes "
+            f"are {s_lane} long — size s_lane to the population max"
         )
+    # mid-round-dropped clients: predicted a longer stream than they
+    # executed — binned with everyone (the scheduler's view), then pulled
+    # and re-packed by ACTUAL totals into overflow passes below
+    dropped_mask = bin_totals > totals
     L = lanes_per_shard * n_shards
-    # assign[p][lane] = clients (in placement order) for pass p
-    assign: list[list[list[int]]] = []
-    for shard in range(n_shards):
-        slots = np.arange(shard * c_local, (shard + 1) * c_local)
-        order = slots[np.argsort(-totals[slots], kind="stable")]
-        pending = [int(s) for s in order if totals[s] > 0]
-        p = 0
-        while pending:
-            while len(assign) <= p:
-                assign.append([[] for _ in range(L)])
-            loads = np.zeros(lanes_per_shard, np.int64)
-            lane_clients: list[list[int]] = [[] for _ in range(lanes_per_shard)]
-            nxt: list[int] = []
-            for s in pending:
-                lane = int(np.argmin(loads))
-                # the least-loaded lane not fitting means NO lane fits
-                if loads[lane] + totals[s] <= s_lane:
-                    loads[lane] += totals[s]
-                    lane_clients[lane].append(s)
-                else:
-                    nxt.append(s)
-            for li, clients in enumerate(lane_clients):
-                assign[p][shard * lanes_per_shard + li] = clients
-            pending = nxt
-            p += 1
+    assign = _assign_lanes(bin_totals, lanes_per_shard, s_lane, n_shards)
+    if dropped_mask.any():
+        # dropped clients leave their predicted lanes (the lane slot was
+        # reserved by the forecast) and their ACTUAL truncated streams are
+        # re-packed into overflow passes appended after the main ones —
+        # same compiled shapes, extra sequential dispatches, every client
+        # still placed exactly once
+        for p_assign in assign:
+            for li, clients in enumerate(p_assign):
+                p_assign[li] = [s for s in clients if not dropped_mask[s]]
+        assign.extend(_assign_lanes(
+            np.where(dropped_mask, totals, 0), lanes_per_shard, s_lane,
+            n_shards,
+        ))
+        # a main pass whose every client dropped would dispatch a no-op
+        assign = [a for a in assign if any(lane for lane in a)]
     passes = []
     for p_assign in assign:
         slot = np.full((L, s_lane), -1, np.int32)

@@ -23,7 +23,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
+from fedml_tpu.algorithms.base import (
+    Aggregator,
+    EmptyRoundError,
+    fedavg_aggregator,
+)
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core import scan as scanlib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_eval, make_local_train
@@ -52,6 +56,26 @@ class SimConfig:
     # scan — the heterogeneity FedProx/FedNova were designed for, absent from
     # the reference despite the naming, SURVEY §5.3)
     straggler_frac: float = 0.0
+    # Heterogeneous population model (fedml_tpu/population, docs/
+    # PERFORMANCE.md "Heterogeneous populations"): a spec string
+    # ("speed=lognormal:0,0.5;avail=0.8;dropout=0.05", see
+    # population.parse_population_spec) drives cohort ELIGIBILITY
+    # (availability on/off blocks feed the sampler), per-client STEP
+    # BUDGETS from the speed multipliers (replacing the uniform
+    # straggler_frac draw — setting both fails loudly), and MID-ROUND
+    # DROPOUT injection (a dropped member trains part of its budget and
+    # its update is excluded, weight 0). The packed-lane planner bins by
+    # the population's PREDICTED steps and re-packs dropped lanes into
+    # overflow passes. None (default) keeps every path bit-identical to
+    # the population-free engine (tools/population_smoke.py).
+    population: str | None = None
+    # Replay a saved population trace (population.save_trace JSONL)
+    # instead of drawing from the spec: cohorts, budgets, and dropouts
+    # reproduce bit-exactly. Exactly one of population/population_trace.
+    population_trace: str | None = None
+    # Seed for the population's draws (None = the run seed): separate so
+    # the same federated run can be replayed under another realization.
+    population_seed: int | None = None
     # Server-side per-client evaluation at test frequency (reference
     # FedAVGAggregator.test_on_server_for_all_clients, FedAVGAggregator.py:110-164)
     eval_on_clients: bool = False
@@ -209,6 +233,63 @@ class FedSim:
                 "(expected 'vmap' or 'scan') — a silent fallback here would "
                 "benchmark or OOM the wrong execution mode"
             )
+        # -- heterogeneous population (fedml_tpu/population, docs/
+        # PERFORMANCE.md "Heterogeneous populations"): resolve the spec or
+        # trace into the round-view provider driving cohorts/budgets/dropout
+        self._population = None
+        self._pop_view_cache: tuple | None = None
+        if config.population or config.population_trace:
+            from fedml_tpu import population as poplib
+
+            if config.population and config.population_trace:
+                raise ValueError(
+                    "SimConfig.population and SimConfig.population_trace "
+                    "are both set — one of them would silently win; pick "
+                    "the generative spec OR the trace replay"
+                )
+            if config.straggler_frac > 0:
+                raise ValueError(
+                    "SimConfig.population replaces the uniform "
+                    "straggler_frac draw with speed-model step budgets — "
+                    "configure per-client heterogeneity in exactly one "
+                    "place (drop straggler_frac)"
+                )
+            pop_seed = (config.population_seed
+                        if config.population_seed is not None
+                        else config.seed)
+            if config.population_trace:
+                self._population = poplib.load_trace(config.population_trace)
+                if self._population.num_clients != config.client_num_in_total:
+                    raise ValueError(
+                        f"population trace {config.population_trace} was "
+                        f"captured over {self._population.num_clients} "
+                        f"clients but client_num_in_total="
+                        f"{config.client_num_in_total} — a trace replays "
+                        "one population only"
+                    )
+                if self._population.jitter_active:
+                    # same contract as the generative spec path below: a
+                    # wire-captured schedule replayed on sim must not
+                    # silently lose its jitter dimension
+                    raise NotImplementedError(
+                        f"population trace {config.population_trace} "
+                        "records upload-arrival jitter — a wire-only "
+                        "knob; there is no wire on the sim engine "
+                        "(re-capture without jitter, or run the "
+                        "message-passing backends)"
+                    )
+            else:
+                spec = poplib.parse_population_spec(config.population)
+                if spec.jitter_active:
+                    raise NotImplementedError(
+                        "population jitter schedules upload-arrival delays "
+                        "— a wire-only knob; there is no wire on the sim "
+                        "engine (run the message-passing backends, or drop "
+                        "jitter from the spec)"
+                    )
+                self._population = poplib.Population(
+                    spec, config.client_num_in_total, pop_seed
+                )
         robust_on = (config.robust_rule != "mean" or config.norm_bound > 0
                      or config.dp_stddev > 0)
         if robust_on and aggregator is not None:
@@ -263,6 +344,13 @@ class FedSim:
             from fedml_tpu.compress import make_codec
             from fedml_tpu.compress.aggregate import compressed_aggregator
 
+            if self._population is not None and config.error_feedback:
+                raise ValueError(
+                    "sim-mode error feedback keys residuals by cohort "
+                    "slot; a population's availability churn maps slots "
+                    "to different clients every round — use "
+                    "error_feedback=False or a message-passing backend"
+                )
             if (config.error_feedback
                     and config.client_num_per_round != config.client_num_in_total):
                 raise ValueError(
@@ -286,6 +374,13 @@ class FedSim:
         # per-client persistent models (decentralized/gossip FL): each client
         # trains from its own round-(r-1) model instead of a broadcast global
         self._per_client = bool(getattr(self.aggregator, "per_client", False))
+        if self._per_client and self._population is not None:
+            raise ValueError(
+                "per-client aggregators (decentralized/gossip) keep slot i "
+                "== client i with full participation every round; a "
+                "population's availability churn breaks that identity — "
+                "run populations with broadcast-mode aggregation"
+            )
         if self._per_client and config.client_num_per_round != config.client_num_in_total:
             raise ValueError(
                 "per-client aggregators (decentralized/gossip) require full "
@@ -1258,7 +1353,10 @@ class FedSim:
         batches, weights = cohortlib.stack_cohort(
             self.train_data, cohort, cfg.batch_size, steps=self._steps, rng=shuffle
         )
+        # budgets first: their cohort-identity check fails loudly before
+        # the dropout weight mask could hit a shape mismatch
         num_steps = self._round_budgets(cohort, round_idx)
+        weights = self._population_weights(weights, round_idx)
         # Pad the cohort axis to a multiple of the mesh's client axis with
         # zero-weight dummy clients (fully masked, excluded from the weighted
         # aggregation) so the stack shards evenly over devices.
@@ -1282,10 +1380,60 @@ class FedSim:
         num_steps = self._put(num_steps, scalar_sharding)
         return batches, weights, num_steps
 
+    def _population_view(self, round_idx: int):
+        """The round's realized population state (cached per round — the
+        sampler, budget, weight, and pack hooks all read it). Raises the
+        wire path's :class:`EmptyRoundError` when availability churn leaves
+        the round with nothing to aggregate, instead of a downstream
+        shape/NaN error."""
+        cached = self._pop_view_cache
+        if cached is not None and cached[0] == round_idx:
+            return cached[1]
+        view = self._population.round_view(
+            round_idx, self.config.client_num_per_round
+        )
+        if view.eligible_count == 0 or not view.real().any():
+            raise EmptyRoundError(
+                f"round {round_idx}: availability churn left no eligible "
+                f"clients (population of {self._population.num_clients}, "
+                "0 available) — nothing to aggregate; widen avail/"
+                "avail_block or skip the round"
+            )
+        if bool((view.dropped | ~view.real()).all()):
+            raise EmptyRoundError(
+                f"round {round_idx}: every sampled cohort member "
+                f"({int(view.real().sum())} of "
+                f"{view.cohort_size}) dropped mid-round — no update "
+                "survives to aggregate (the wire path's all-dropped-round "
+                "semantics)"
+            )
+        self._pop_view_cache = (round_idx, view)
+        return view
+
+    def _population_budgets(self, view) -> tuple[np.ndarray, np.ndarray]:
+        """(actual, predicted) per-slot step budgets for a population
+        round, in scan-step units against the engine's epochs x steps
+        chain (population.step_budgets does the mapping)."""
+        from fedml_tpu.population import step_budgets
+
+        return step_budgets(view, self.trainer.epochs * self._steps)
+
     def _round_budgets(self, cohort, round_idx: int) -> np.ndarray:
         """Per-client local-step budgets (scan-step units): stragglers run a
-        reduced epoch count e_i, i.e. the first e_i * steps-per-epoch steps."""
+        reduced epoch count e_i, i.e. the first e_i * steps-per-epoch steps.
+        With a population configured, budgets come from its per-client
+        speed model instead (dropout truncation included)."""
         cfg = self.config
+        if self._population is not None:
+            view = self._population_view(round_idx)
+            if not np.array_equal(np.asarray(cohort), view.cohort):
+                raise ValueError(
+                    "SimConfig.population drives cohort selection; "
+                    "compositions that pick their own cohorts (e.g. "
+                    "hierarchical groups) need the population off"
+                )
+            actual, _ = self._population_budgets(view)
+            return actual
         if cfg.straggler_frac > 0.0:
             from fedml_tpu.algorithms.fedprox import straggler_epochs
 
@@ -1295,6 +1443,18 @@ class FedSim:
         else:
             epochs_arr = np.full(len(cohort), cfg.epochs, np.int32)
         return (epochs_arr * self._steps).astype(np.int32)
+
+    def _population_weights(self, weights: np.ndarray,
+                            round_idx: int) -> np.ndarray:
+        """Zero the aggregation weight of mid-round-dropped cohort members:
+        they trained part of their budget (the FLOPs are real) but their
+        update never reaches the server — excluded from the weighted mean
+        and the loss average exactly like a padding slot. No-op without a
+        population."""
+        if self._population is None:
+            return weights
+        view = self._population_view(round_idx)
+        return np.where(view.dropped, 0.0, weights).astype(np.float32)
 
     def _host_cohort_indices(self, cohort, round_idx: int):
         """Host-side index staging: [C_pad, S, B] int32 index map (-1 = empty
@@ -1313,6 +1473,7 @@ class FedSim:
             rng=shuffle,
         )
         num_steps = self._round_budgets(cohort, round_idx)
+        weights = self._population_weights(weights, round_idx)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
         pad = (-len(cohort)) % n_dev
         if pad:
@@ -1342,6 +1503,12 @@ class FedSim:
             # stable identity order: slot i is client i every round, so the
             # persistent stack and the mixing matrix's adjacency line up
             return np.arange(cfg.client_num_in_total)
+        if self._population is not None:
+            # availability-aware sampling (population/model.py): the view's
+            # cohort is always exactly client_num_per_round slots — churn
+            # that leaves fewer eligible clients pads with -1 empty slots,
+            # so compiled shapes never change
+            return self._population_view(round_idx).cohort
         return rnglib.sample_clients(
             round_idx, cfg.client_num_in_total, cfg.client_num_per_round
         )
@@ -1395,9 +1562,24 @@ class FedSim:
         B = self.config.batch_size
         valid_counts = (idx >= 0).reshape(len(weights), -1).sum(axis=1)
         data_steps = -(-valid_counts // B)
+        predicted = None
+        if self._population is not None:
+            # the planner bins by the population's PREDICTED budgets (the
+            # scheduler cannot know who drops mid-round); dropped lanes are
+            # re-packed by their actual truncated streams into overflow
+            # passes inside pack_cohort
+            _, predicted = self._population_budgets(
+                self._population_view(round_idx)
+            )
+            pad = len(weights) - len(predicted)
+            if pad:
+                predicted = np.concatenate(
+                    [predicted, np.zeros(pad, np.int32)]
+                )
         plan = cohortlib.pack_cohort(
             num_steps, data_steps, self._steps, self.trainer.epochs,
             self.config.pack_lanes, self._s_lane, self._n_client_shards,
+            predicted_steps=predicted,
         )
         return idx, weights, num_steps, plan
 
@@ -1580,6 +1762,15 @@ class FedSim:
             ),
             "total_leaves": len(leaves),
         }
+
+    def population_summary(self) -> dict:
+        """Static population accounting (empty when no population is
+        configured): the spec/trace identity and geometry — the
+        observability hook exp loops log at run start (mirrors
+        :meth:`pack_summary`)."""
+        if self._population is None:
+            return {}
+        return self._population.describe()
 
     def defense_summary(self) -> dict:
         """Static robust-defense accounting (empty when no defense stage is

@@ -237,6 +237,56 @@ def test_traced_purity_fires_and_negatives(tmp_path):
                for f in live)
 
 
+def test_traced_purity_module_wide_bans(tmp_path):
+    # banned-module-calls: np.random.* is illegal at ANY scope in modules
+    # under the configured prefix (the population subsystem's replay-
+    # determinism contract), while other modules keep the traced-only rule
+    cfg = dataclasses.replace(
+        FedlintConfig(),
+        banned_module_calls=("pkg/population/:np.random.*",),
+    )
+    src_pop = """
+        import numpy as np
+
+        def draw(n):
+            return np.random.rand(n)        # module-wide ban: fires
+
+        SEEDED = np.random.RandomState(0)   # module scope: fires
+        """
+    src_other = """
+        import numpy as np
+
+        def draw(n):
+            return np.random.rand(n)        # not under the prefix: clean
+        """
+    live, _, _ = lint(tmp_path, {
+        "pkg/population/model.py": src_pop,
+        "pkg/other.py": src_other,
+    }, select=["traced-purity"], config=cfg)
+    assert len(live) == 2, [(f.path, f.line) for f in live]
+    assert all(f.path == "pkg/population/model.py" for f in live)
+    assert all("banned module-wide" in f.message for f in live)
+    # a justified waiver suppresses (but keeps) the finding, as usual
+    waived_src = src_pop.replace(
+        "SEEDED = np.random.RandomState(0)   # module scope: fires",
+        "# fedlint: disable=traced-purity -- the one seeded constructor\n"
+        "        SEEDED = np.random.RandomState(0)",
+    )
+    live2, all2, _ = lint(tmp_path, {
+        "pkg/population/model.py": waived_src,
+    }, select=["traced-purity"], config=cfg)
+    assert len(live2) == 1 and live2[0].line == 5
+    assert any(f.waived for f in all2)
+    # a malformed entry fails loudly at rule construction
+    from fedml_tpu.analysis import make_rules
+
+    with pytest.raises(ValueError, match="banned-module-calls"):
+        make_rules(dataclasses.replace(
+            FedlintConfig(), banned_module_calls=("no-colon-pattern",),
+            select=("traced-purity",),
+        ))
+
+
 # -- rule: metric-keys -------------------------------------------------------
 
 

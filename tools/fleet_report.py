@@ -129,6 +129,13 @@ def summarize(view: dict, rounds: int = 0) -> dict:
                                else round(stale_h.mean(), 3)),
             "staleness_max": (None if stale_h is None else stale_h.max),
             "heartbeat_age_s": g.get("heartbeat_age_s"),
+            # population churn gauges (population/wire.py adapter +
+            # fleet-telemetry piggyback): cumulative predicted-vs-actual
+            # step totals and the rank's dropped-upload count — present
+            # only on population-driven runs
+            "pop_predicted_steps": g.get("pop_predicted_steps"),
+            "pop_actual_steps": g.get("pop_actual_steps"),
+            "pop_dropped_uploads": g.get("pop_dropped_uploads"),
             "gauges": dict(g),
             # every histogram the rank carries, not just the three fleet-
             # wide ones (a tree root's per-tier "folds" distribution lives
@@ -197,6 +204,24 @@ def format_text(report: dict) -> str:
             f"{_na(r['upload_ms_p50']):>9} {_na(r['upload_ms_p99']):>9} "
             f"{_na(r['staleness_mean']):>9} {_na(r['staleness_max'], '{:g}'):>5}"
         )
+    churn = [r for r in report["per_rank"]
+             if r.get("pop_predicted_steps") is not None]
+    if churn:
+        lines += [
+            "",
+            "population churn (cumulative steps: speed-model forecast vs "
+            "actually run; uploads lost to dropout):",
+            f"{'rank':>4} {'predicted':>10} {'actual':>10} {'pred/act':>9} "
+            f"{'dropped':>8}",
+        ]
+        for r in churn:
+            pred = r["pop_predicted_steps"]
+            act = r.get("pop_actual_steps") or 0
+            ratio = round(pred / act, 3) if act else None
+            lines.append(
+                f"{r['rank']:>4} {pred:>10g} {act:>10g} "
+                f"{_na(ratio):>9} {_na(r.get('pop_dropped_uploads'), '{:g}'):>8}"
+            )
     for name in FLEET_HISTOGRAMS:
         lines += _render_histogram(name, report["histograms"].get(name))
     if report["timelines"]:
