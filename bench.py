@@ -522,6 +522,82 @@ def bench_broadcast_ab(n_fanouts: int = 25):
     return out
 
 
+def bench_downlink_ab(n_rounds: int = 4):
+    """Dense vs delta+q8 downlink at an N=8 loopback fan-out
+    (docs/COMPRESSION.md "Downlink delta coding"): arm A is today's dense
+    model broadcast, arm B arms the downlink delta plane with the q8
+    codec — each round close encodes the new global once against the
+    previous emitted version and the fan-out serves encoded chains. The
+    probe reports downlink bytes/round off the wire accountant (real
+    encoded payload + descriptor bytes, not theory) and fan-out rounds/sec
+    for both arms. Bytes reduction is a property of the codec and the
+    model size — platform-independent, so the probe stays meaningful on
+    XLA:CPU fallback (the run stamps cpu_fallback as usual)."""
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.compress import make_codec
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import metrics as metricslib
+
+    workers = BROADCAST_WORKERS
+    # a model big enough that the chain descriptor amortizes (the bytes
+    # claim is about model payloads; tiny fixtures are all descriptor)
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=24,
+                              num_classes=4, dim=4096, seed=0)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+
+    def run(downlink):
+        comm: dict = {}
+        kwargs = {}
+        if downlink:
+            # ONE codec object for warm-up and timed run: the jitted
+            # encode/decode programs are cached per codec instance
+            kwargs = dict(downlink_codec=make_codec("q8"),
+                          downlink_keyframe_every=64)
+        # warm with the SAME arm config (compile + thread spinup — the
+        # delta arm's one-time jit compile must not bill the timed window)
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=workers, round_num=1, batch_size=8,
+            **kwargs,
+        )
+        t0 = time.perf_counter()
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=workers, round_num=n_rounds,
+            batch_size=8, comm_stats=comm if downlink else None, **kwargs,
+        )
+        return n_rounds / (time.perf_counter() - t0), comm
+
+    dense_rps, _ = run(False)
+    delta_rps, comm = run(True)
+    rounds = comm["rounds"]
+    down = [r[metricslib.COMM_DOWNLINK_BYTES] for r in rounds]
+    dense_equiv = [r[metricslib.COMM_DOWNLINK_DENSE_BYTES] for r in rounds]
+    # steady state excludes the init keyframe (round 0's record carries it;
+    # it amortizes over a real deployment's horizon)
+    steady = [r[metricslib.COMM_DOWNLINK_RATIO] for r in rounds[1:]
+              if metricslib.COMM_DOWNLINK_KEYFRAMES not in r]
+    return {
+        "downlink_dense_rounds_per_sec": round(dense_rps, 2),
+        "downlink_delta_rounds_per_sec": round(delta_rps, 2),
+        "downlink_bytes_per_round": int(np.mean(down)),
+        "downlink_dense_bytes_per_round": int(np.mean(dense_equiv)),
+        "downlink_ratio_total": round(sum(dense_equiv) / sum(down), 2),
+        "downlink_ratio_steady_state": (
+            round(float(np.mean(steady)), 2) if steady else None
+        ),
+        "downlink_workers": workers,
+    }
+
+
 def bench_robust_ab(n_rounds: int = 4):
     """Robust streaming vs plain streaming rounds/sec on the loopback
     message-passing path (docs/ROBUSTNESS.md): arm A folds each upload
@@ -1350,6 +1426,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_broadcast_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["broadcast_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_downlink_probe"
+    try:
+        pipeline_extra.update(bench_downlink_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["downlink_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_robust_probe"
     try:

@@ -296,7 +296,10 @@ class FedAvgServerManager(ServerManager):
                  readmission: bool = False,
                  checkpointer=None,
                  checkpoint_every: int = 1,
-                 fleet=None):
+                 fleet=None,
+                 downlink_codec=None,
+                 downlink_keyframe_every: int = 8,
+                 downlink_retention: int = 4):
         super().__init__(comm, rank=0, size=worker_num + 1)
         self.worker_num = worker_num
         self.round_num = round_num
@@ -362,6 +365,35 @@ class FedAvgServerManager(ServerManager):
         # here so the loss is visible (Comm/StaleUploads in comm_stats
         # totals; the async server folds them weighted instead)
         self.stale_uploads = 0  # guarded-by: _round_lock
+        # downlink delta coding (compress/downlink.py, docs/COMPRESSION.md
+        # "Downlink delta coding"): when armed, every round close encodes
+        # the new global ONCE as a delta against the previous emitted
+        # version, the global of record becomes the DECODED model (so
+        # quantization error never accumulates), and fan-outs serve each
+        # rank by the version it last echoed — one-step delta, cumulative
+        # chain, or periodic keyframe. None (default) keeps the dense
+        # broadcast bit-identical to the pre-downlink protocol.
+        self.downlink = None
+        # rank -> newest model version the rank PROVABLY holds (its upload
+        # echo; monotonic) — downlink legs can fail, so only the echo is
+        # trusted as the delta base
+        self._held_versions: dict[int, int] = {}  # guarded-by: _round_lock
+        # cumulative encoded downlink bytes actually sent per rank (fleet
+        # telemetry gauge; tools/fleet_report.py renders it)
+        self._downlink_sent: dict[int, int] = {}  # guarded-by: _round_lock
+        if downlink_codec is not None:
+            from fedml_tpu.compress.downlink import DownlinkCodecState
+
+            self.downlink = DownlinkCodecState(
+                downlink_codec, model_desc,
+                keyframe_every=downlink_keyframe_every,
+                retention=downlink_retention,
+            )
+            self.global_flat = self.downlink.reset(init_flat, self.round_idx)
+        # bytes-on-wire ledger: armed by the downlink plane here, by the
+        # encoded-uplink subclass via its _make_accountant override — the
+        # same factory discipline as _make_aggregator
+        self.accountant = self._make_accountant()
         # the ONE aggregator construction (fedlint: overwrite-after-super;
         # ROADMAP item 1's factory seam): subclasses override
         # _make_aggregator and hoist whatever config it reads (codec,
@@ -379,6 +411,16 @@ class FedAvgServerManager(ServerManager):
             BufferedFedAvgDistAggregator if self.buffered_aggregation
             else FedAvgDistAggregator
         )(self.worker_num)
+
+    def _make_accountant(self):
+        """Build the bytes-on-wire ledger (or None when nothing encodes).
+        Called exactly once at base init; the encoded-uplink subclass
+        overrides it to always account."""
+        if self.downlink is None:
+            return None
+        from fedml_tpu.obs.metrics import CommBytesAccountant
+
+        return CommBytesAccountant()
 
     def _model_payload(self, rank: int):
         """Model payload for ``rank`` — the wire-format seam. Base sends the
@@ -399,8 +441,26 @@ class FedAvgServerManager(ServerManager):
         """Extra header params stamped on every downlink sync — the async
         server adds the explicit global-model version here (clients train
         against a version, not a sync count). Header-only scalars: they ride
-        the per-receiver head, never the shared payload frame."""
+        the per-receiver head, never the shared payload frame. The downlink
+        delta plane needs the same stamp on the SYNC protocol too — clients
+        echo it, and the echo is the only trusted delta base."""
+        if self.downlink is not None:
+            return {Message.MSG_ARG_KEY_MODEL_VERSION: self.round_idx}
         return {}
+
+    def _note_version_echo(self, sender: int, msg: Message) -> None:  # lock-held: _round_lock
+        """Record the model version a rank echoed on its upload — monotonic,
+        and noted for EVERY upload (stale and duplicate included: the echo
+        proves possession regardless of what the tally does with the
+        payload). The delta fan-out serves each rank from this base."""
+        if self.downlink is None:
+            return
+        v = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
+        if v is None:
+            return
+        prev = self._held_versions.get(sender)
+        if prev is None or int(v) > prev:
+            self._held_versions[sender] = int(v)
 
     def _decode_upload(self, msg: Message) -> np.ndarray:
         """Inverse seam: a client upload back to the flat byte vector."""
@@ -414,24 +474,72 @@ class FedAvgServerManager(ServerManager):
         per-rank JSON payloads fall back to singleton groups); per-rank
         scalars (the assigned client index) ride per-receiver header
         overrides. ``use_broadcast=False`` replays the legacy per-rank
-        ``send_message`` loop for A/B comparison."""
+        ``send_message`` loop for A/B comparison.
+
+        With the downlink delta plane armed, ranks are served by the model
+        version they last echoed: same gap -> same shared chain blob (one
+        frame / one object-store put per distinct version-gap per fan-out),
+        with the base version riding a header-only per-receiver override;
+        ranks without a usable base get the dense keyframe. The init
+        fan-out (``include_desc``) is always the dense keyframe."""
         if not ranks:
             return
-        payloads = {w: self._model_payload(w) for w in ranks}
+        dense_nbytes = len(self.global_flat)
+        serves = held = None
+        # the init fan-out (include_desc) is always the dense keyframe, and
+        # finished fan-outs ship dense too: receivers short-circuit on the
+        # FINISHED flag without decoding, so building chain blobs (and
+        # possibly warning about a long-excluded rank's retired base) for
+        # them would be pure waste on a healthy shutdown
+        if self.downlink is not None and not include_desc and not finished:
+            with self._round_lock:
+                held = {w: self._held_versions.get(w) for w in ranks}
+            serves = {w: self.downlink.serve(held[w]) for w in ranks}
+            retired = sorted(w for w, s in serves.items()
+                             if s[0] == "keyframe" and s[2])
+            if retired:
+                logging.warning(
+                    "downlink delta base RETIRED for ranks %s (%s): serving "
+                    "the full keyframe instead — raise downlink_retention / "
+                    "broadcast_generations if this recurs",
+                    retired, "; ".join(serves[w][1] for w in retired),
+                )
+        payloads = {
+            w: (serves[w][1]
+                if serves is not None and serves[w][0] == "delta"
+                else self._model_payload(w))
+            for w in ranks
+        }
         groups: dict[int, list[int]] = {}
         for w in ranks:
             groups.setdefault(id(payloads[w]), []).append(w)
+        sent_bytes: dict[int, int] = {}
         for group in groups.values():
+            s = serves[group[0]] if serves is not None else None
+            is_delta = s is not None and s[0] == "delta"
             per_receiver = None
-            if cohort is not None:
-                per_receiver = {
-                    w: {MyMessage.MSG_ARG_KEY_CLIENT_INDEX: int(cohort[w - 1])}
-                    for w in group
-                }
-            if self.use_broadcast:
-                msg = Message(msg_type, 0, group[0])
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                               payloads[group[0]])
+            if cohort is not None or is_delta:
+                per_receiver = {}
+                for w in group:
+                    ov: dict = {}
+                    if cohort is not None:
+                        ov[MyMessage.MSG_ARG_KEY_CLIENT_INDEX] = int(
+                            cohort[w - 1])
+                    if is_delta:
+                        # header-only per-receiver base version: every
+                        # receiver of the shared payload frame validates the
+                        # chain against ITS own base (never a payload re-pack)
+                        ov[Message.MSG_ARG_KEY_BASE_VERSION] = int(held[w])
+                    per_receiver[w] = ov
+
+            def build(dst: int) -> Message:
+                msg = Message(msg_type, 0, dst)
+                if is_delta:
+                    msg.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE, s[1])
+                    msg.add_params(Message.MSG_ARG_KEY_ENCODED_DESC, s[2])
+                else:
+                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                                   payloads[dst])
                 # the authoritative round index rides every sync: clients
                 # train AS this round instead of counting received syncs,
                 # so a duplicated/replayed downlink leg (comm/faults.py dup)
@@ -444,26 +552,28 @@ class FedAvgServerManager(ServerManager):
                                    self.model_desc)
                 if finished:
                     msg.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
+                return msg
+
+            # bytes actually on the wire per receiver: the encoded chain +
+            # its descriptor on the delta path, the dense model otherwise
+            actual = (int(s[1].size) + len(s[2])) if is_delta else dense_nbytes
+            if self.accountant is not None:
+                for _w in group:
+                    self.accountant.record_downlink(actual, dense_nbytes)
+                if self.downlink is not None and not is_delta:
+                    self.accountant.record_keyframes(len(group))
+            for w in group:
+                sent_bytes[w] = actual
+            if self.use_broadcast:
                 try:
-                    self.broadcast_message(msg, group,
+                    self.broadcast_message(build(group[0]), group,
                                            per_receiver=per_receiver)
                 except BroadcastSendError as e:
                     self._downlink_failed(e.errors)
             else:
                 errors: dict[int, BaseException] = {}
                 for w in group:
-                    msg = Message(msg_type, 0, w)
-                    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                                   payloads[w])
-                    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX,
-                                   self.round_idx)
-                    for k, v in self._sync_extra_params().items():
-                        msg.add_params(k, v)
-                    if include_desc:
-                        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_DESC,
-                                       self.model_desc)
-                    if finished:
-                        msg.add_params(Message.MSG_ARG_KEY_FINISHED, 1)
+                    msg = build(w)
                     if per_receiver is not None:
                         for k, v in per_receiver[w].items():
                             msg.add_params(k, v)
@@ -475,6 +585,13 @@ class FedAvgServerManager(ServerManager):
                         errors[w] = e
                 if errors:
                     self._downlink_failed(errors)
+        if self.downlink is not None:
+            with self._round_lock:
+                for w, b in sent_bytes.items():
+                    self._downlink_sent[w] = self._downlink_sent.get(w, 0) + b
+                    if self.fleet is not None:
+                        self.fleet.gauge(w, "downlink_bytes",
+                                         self._downlink_sent[w])
 
     def _downlink_failed(self, errors: dict[int, BaseException]) -> None:
         """Per-destination fan-out failures are NOT fatal to the round
@@ -549,6 +666,10 @@ class FedAvgServerManager(ServerManager):
         # round-r model slip into round r+1's tally
         with self._round_lock:
             current = self.round_idx
+            # the version echo is trusted for EVERY upload — stale and
+            # duplicate ones included: the echo proves what model this rank
+            # holds regardless of what the tally does with the payload
+            self._note_version_echo(sender, msg)
             if not self.aggregator.is_live(sender - 1):
                 if self.readmission:
                     # excluded worker resurfaced WITH an upload: provably
@@ -673,6 +794,14 @@ class FedAvgServerManager(ServerManager):
                 self._round_timer = None
             self.global_flat = self.aggregator.aggregate()
             self.round_idx += 1
+            if self.downlink is not None:
+                # encode-once at round close: the delta (against the
+                # previous DECODED version) lands in the serve chain, and
+                # the global of record becomes the decoded model — what
+                # every client reconstructs, so quantization error never
+                # accumulates across rounds
+                self.global_flat = self.downlink.advance(self.global_flat,
+                                                         self.round_idx)
             # readmission boundary: workers that re-contacted the server
             # while excluded re-enter the expected set HERE, never
             # mid-round (a mid-round readmit would stall the all-received
@@ -791,6 +920,14 @@ class FedAvgServerManager(ServerManager):
             for cid, st in state.get("status", {}).items():
                 self.status.update(int(cid), st, touch=False)
             self.aggregator.restore_state(state.get("aggregator", {}))
+            if self.downlink is not None:
+                # the delta chain and the held-version table died with the
+                # crashed process: re-anchor on a keyframe (the checkpointed
+                # global IS the decoded model) and let echoes rebuild the
+                # table — every client's first post-resume sync is dense
+                self.global_flat = self.downlink.reset(self.global_flat,
+                                                       self.round_idx)
+                self._held_versions.clear()
         logging.info("restored server round state: resuming as round %d "
                      "(live workers %s)", self.round_idx,
                      [w + 1 for w in self.aggregator.live_workers()])
@@ -824,6 +961,12 @@ class FedAvgClientManager(ClientManager):
         # registry installed for unrelated gauges must never change what
         # goes on the wire
         self.fleet_telemetry = False
+        # downlink delta coding (compress/downlink.py): the runner arms
+        # every client with the run's downlink codec; the decoder (the
+        # mutable held model + version) is built at the first keyframe.
+        # None keeps _decode_model the zero-copy dense path bit-identically.
+        self.downlink_codec = None
+        self._downlink = None
         # per-rank population profile (population/wire.py adapter; set by
         # the runner under population=): feeds the predicted-vs-actual
         # step gauges piggybacked when fleet telemetry is on
@@ -836,12 +979,52 @@ class FedAvgClientManager(ClientManager):
     def _decode_model(self, msg: Message):
         """Wire-format seam: a sync payload back to model variables. The
         mobile client (fedavg_mobile.py) parses the reference's nested-list
-        JSON here instead."""
-        flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+        JSON here instead.
+
+        Downlink delta coding (compress/downlink.py): a sync carrying an
+        encoded-update payload is a delta CHAIN — applied step-by-step onto
+        this client's held version with the server's exact f32 add
+        sequence, so the reconstruction is bit-exact. Dense syncs are
+        keyframes: with the plane armed they replace the held copy; without
+        it this is the unchanged zero-copy dense path."""
         desc = msg.get(MyMessage.MSG_ARG_KEY_MODEL_DESC)
         if desc is not None:
             self._desc = desc
-        return unpack_pytree(flat, self._desc)
+        chain = msg.get(Message.MSG_ARG_KEY_ENCODED_UPDATE)
+        if chain is None:
+            flat = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+            version = getattr(self, "_model_version", None)
+            if self.downlink_codec is not None and version is not None:
+                from fedml_tpu.compress.downlink import DownlinkDecoder
+
+                if self._downlink is None:
+                    self._downlink = DownlinkDecoder(self.downlink_codec)
+                flat = self._downlink.apply_keyframe(flat, version).view(
+                    np.uint8)
+            return unpack_pytree(flat, self._desc)
+        if self.downlink_codec is None:
+            raise RuntimeError(
+                "received a delta-coded sync but this client has no "
+                "downlink codec — server and clients must be armed with "
+                "the same --downlink_compressor"
+            )
+        if self._downlink is None:
+            raise RuntimeError(
+                "delta-coded sync before any keyframe: the init sync is "
+                "always dense, so this client missed it (protocol bug)"
+            )
+        held = self._downlink.apply_chain(
+            np.asarray(chain),
+            msg.get(Message.MSG_ARG_KEY_ENCODED_DESC),
+            msg.get(Message.MSG_ARG_KEY_BASE_VERSION),
+            getattr(self, "_model_version", None),
+        )
+        # echo what the decoder actually RECONSTRUCTED, not the header
+        # stamp: a fan-out racing a round close can stamp one version off,
+        # and an echo ahead of the held model would make the next delta
+        # serve a base this client does not hold
+        self._model_version = self._downlink.version
+        return unpack_pytree(held.view(np.uint8), self._desc)
 
     def _encode_model(self, new_vars):
         """Inverse seam: trained variables to the upload payload."""
@@ -1002,9 +1185,14 @@ class CompressedFedAvgServerManager(FedAvgServerManager):
         # _make_aggregator() call sees it (the factory seam, ROADMAP item 1)
         self.codec = codec
         super().__init__(*args, **kwargs)
+
+    def _make_accountant(self):
+        # the encoded uplink always accounts (downlink bytes are recorded
+        # by the shared fan-out path — dense unless the delta plane is
+        # armed on top)
         from fedml_tpu.obs.metrics import CommBytesAccountant
 
-        self.accountant = CommBytesAccountant()
+        return CommBytesAccountant()
 
     def _make_aggregator(self):
         agg = (
@@ -1013,11 +1201,6 @@ class CompressedFedAvgServerManager(FedAvgServerManager):
         )(self.worker_num, self.codec)
         agg.get_global = lambda: self.global_flat
         return agg
-
-    def _model_payload(self, rank: int):
-        flat = super()._model_payload(rank)
-        self.accountant.record_downlink(len(flat), len(flat))
-        return flat
 
     def _decode_upload(self, msg: Message):
         from fedml_tpu.comm.message import unpack_encoded_update
@@ -1151,6 +1334,9 @@ def run_distributed_fedavg(
     client_cls_for_rank: Callable[[int], type] | None = None,
     codec=None,
     error_feedback: bool = True,
+    downlink_codec=None,
+    downlink_keyframe_every: int = 8,
+    downlink_retention: int = 4,
     comm_stats: dict | None = None,
     robust_config=None,
     robust_stats: dict | None = None,
@@ -1184,6 +1370,17 @@ def run_distributed_fedavg(
     (a robust_distributed.RobustDistConfig) swaps the server tally for the
     streaming Byzantine-robust + DP one, composing with ``codec``
     (``robust_stats`` receives per-round Robust/* records).
+    ``downlink_codec`` (a codec or ``--downlink_compressor`` spec; 'none'
+    resolves to the unchanged dense broadcast) arms the downlink delta
+    plane (compress/downlink.py, docs/COMPRESSION.md "Downlink delta
+    coding"): round closes encode the new global once as a quantized
+    delta against the previous emitted version, fan-outs serve each rank
+    by its echoed version (one-step delta / cumulative chain / every
+    ``downlink_keyframe_every``-th version a dense keyframe; the chain
+    keeps ``downlink_retention`` steps, raised by the async server's
+    staleness p99), and reconstruction is bit-exact because the global of
+    record becomes the decoded model. Composes with ``codec`` (both
+    directions encoded), ``robust_config``, and ``server_mode='async'``.
     ``fault_specs`` (comm/faults.py: a {rank: FaultSpec} map or a spec
     string) wraps every rank's transport in the seeded fault injector.
 
@@ -1244,6 +1441,18 @@ def run_distributed_fedavg(
                               or client_cls_for_rank is not None):
         raise ValueError(
             "codec= does not compose with custom manager classes "
+            "(e.g. is_mobile's JSON wire format)"
+        )
+    if downlink_codec is not None:
+        # accept a codec or a --downlink_compressor spec string; 'none'
+        # resolves to the unchanged dense broadcast (bit-identity arm)
+        from fedml_tpu.compress.downlink import resolve_downlink_codec
+
+        downlink_codec = resolve_downlink_codec(downlink_codec)
+    if downlink_codec is not None and (server_cls is not None
+                                       or client_cls_for_rank is not None):
+        raise ValueError(
+            "downlink_codec= does not compose with custom manager classes "
             "(e.g. is_mobile's JSON wire format)"
         )
     if robust_config is not None and not robust_config.enabled:
@@ -1368,6 +1577,12 @@ def run_distributed_fedavg(
 
             return make
 
+    if downlink_codec is not None:
+        server_kwargs = {**(server_kwargs or {}),
+                         "downlink_codec": downlink_codec,
+                         "downlink_keyframe_every": downlink_keyframe_every,
+                         "downlink_retention": downlink_retention}
+
     if server_mode == "async":
         # remap the selected sync server class onto its barrier-free
         # counterpart (fedml_tpu/async_agg): same wire seams, async tally
@@ -1403,7 +1618,7 @@ def run_distributed_fedavg(
 
     def _done(r, f):
         results["final"] = f
-        if codec is not None and comm_stats is not None:
+        if comm_stats is not None and server.accountant is not None:
             comm_stats.setdefault("rounds", []).append(
                 server.accountant.round_record(r)
             )
@@ -1455,6 +1670,12 @@ def run_distributed_fedavg(
     if fleet_stats is not None:
         for c in clients:
             c.fleet_telemetry = True
+    if downlink_codec is not None:
+        # every client decodes with the SAME codec object the server
+        # encodes with (one shared jitted decode program — the bit-exact
+        # held == decoded contract depends on it)
+        for c in clients:
+            c.downlink_codec = downlink_codec
     if population is not None:
         # per-rank population profile (speed / predicted step fraction):
         # fleet-telemetry-armed clients piggyback predicted-vs-actual step
@@ -1495,7 +1716,7 @@ def run_distributed_fedavg(
     if comm_stats is not None:
         from fedml_tpu.obs import metrics as metricslib
 
-        if codec is not None:
+        if server.accountant is not None:
             comm_stats["totals"] = server.accountant.totals()
         if retry_policy is not None:
             comm_stats.setdefault("totals", {})[metricslib.COMM_RETRY_COUNT] = (
@@ -1617,6 +1838,7 @@ def run_distributed_fedavg_mqtt_s3(
     mqtt_port: int = 1883,
     topic: str = "fedml",
     threshold_bytes: int = 1 << 14,
+    broadcast_generations: int = 2,
     on_round_done: Callable[[int, Any], None] | None = None,
     init_overrides=None,
     **runner_kwargs,
@@ -1630,6 +1852,11 @@ def run_distributed_fedavg_mqtt_s3(
     over the in-process broker (comm/inproc_broker.py); a host string
     connects through real paho. The store is a FileSystemStore under
     ``store_dir`` — the S3Store drops in via the same ObjectStore interface.
+    ``broadcast_generations`` is the sender-side shared-blob retention
+    (how many newer fan-outs exist before a broadcast blob is retired);
+    the async server additionally raises it in place from its observed
+    staleness p99 when the downlink delta plane is armed, so a
+    deliberately slow client never 404s its delta base.
     """
     import tempfile
 
@@ -1653,7 +1880,9 @@ def run_distributed_fedavg_mqtt_s3(
             client_num=worker_num, client_factory=factory,
         )
         return OffloadCommManager(
-            inner, FileSystemStore(store_root), threshold_bytes=threshold_bytes
+            inner, FileSystemStore(store_root),
+            threshold_bytes=threshold_bytes,
+            broadcast_generations=broadcast_generations,
         )
 
     mgrs = {r: make_comm(r) for r in range(worker_num + 1)}

@@ -186,6 +186,11 @@ class EdgeAggregatorManager(DistributedManager):
         self.total_folds = 0  # guarded-by: _edge_lock
         self._window_t0: float | None = None  # guarded-by: _edge_lock
         self._round = 0  # guarded-by: _edge_lock
+        # the model version this tier last re-served downward (downlink
+        # delta plane): echoed on the partial so the ROOT serves this
+        # subtree the right delta base — the children are round-locked
+        # with their tier, so the tier's version IS the subtree's
+        self._model_version: int | None = None  # guarded-by: _edge_lock
         # per-child round of the last ACCEPTED contribution: the tally's
         # first-wins flags reset when the tier forwards its partial, but the
         # tier's round only advances on the next parent sync — a duplicated
@@ -277,17 +282,32 @@ class EdgeAggregatorManager(DistributedManager):
                             self.leaf_base, int(ridx), lost, self._round,
                         )
                     self._round = int(ridx)
+            version = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
+            if version is not None:
+                self._model_version = int(version)
             # snapshot under the lock; the re-broadcast below runs OUTSIDE
             # it (fedlint guarded-by — and a lock held across a fan-out is
             # exactly the PR 10 deadlock shape)
             round_now = self._round
-        payload = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         out = Message(msg.get_type(), 0, 1)
         # encode-once per tier: the children share ONE re-framed payload —
-        # the read-only view of the parent's frame, never a per-child copy
-        out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
+        # the read-only view of the parent's frame, never a per-child copy.
+        # A delta-coded sync (downlink plane) is re-served verbatim: the
+        # edge never decodes — chain blob, descriptor, and base version
+        # pass straight through to the subtree.
+        chain = msg.get(Message.MSG_ARG_KEY_ENCODED_UPDATE)
+        if chain is not None:
+            out.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE,
+                           np.asarray(chain))
+            out.add_params(Message.MSG_ARG_KEY_ENCODED_DESC,
+                           msg.get(Message.MSG_ARG_KEY_ENCODED_DESC))
+            base = msg.get(Message.MSG_ARG_KEY_BASE_VERSION)
+            if base is not None:
+                out.add_params(Message.MSG_ARG_KEY_BASE_VERSION, int(base))
+        else:
+            payload = np.asarray(msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+            out.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, payload)
         out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_now)
-        version = msg.get(Message.MSG_ARG_KEY_MODEL_VERSION)
         if version is not None:
             out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION, version)
         desc = msg.get(MyMessage.MSG_ARG_KEY_MODEL_DESC)
@@ -385,6 +405,12 @@ class EdgeAggregatorManager(DistributedManager):
             out.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, float(wsum))
             out.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, int(count))
             out.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, self._round)
+            if self._model_version is not None:
+                # version echo (downlink delta plane): the root serves this
+                # subtree's next sync as a delta against what the tier —
+                # and therefore its round-locked children — actually holds
+                out.add_params(Message.MSG_ARG_KEY_MODEL_VERSION,
+                               self._model_version)
             if self.fleet_telemetry:
                 # the tier's piggybacked health report (docs/OBSERVABILITY.md
                 # "Fleet telemetry"): window fill time as the tier's step
@@ -442,6 +468,9 @@ class TreeFedAvgServerManager(FedAvgServerManager):
         tel = msg.get(Message.MSG_ARG_KEY_TELEMETRY)
         with self._round_lock:
             current = self.round_idx
+            # downlink delta plane: the tier's echoed version is the delta
+            # base for its whole subtree (noted for stale partials too)
+            self._note_version_echo(sender, msg)
             if not self.aggregator.is_live(sender - 1):
                 if self.readmission:
                     # an excluded tier resurfaced WITH a partial: provably
@@ -530,6 +559,10 @@ def run_tree_fedavg(
     server_kwargs: dict | None = None,
     join_timeout: float = 30.0,
     fleet_stats: dict | None = None,
+    downlink_codec=None,
+    downlink_keyframe_every: int = 8,
+    downlink_retention: int = 4,
+    comm_stats: dict | None = None,
 ):
     """End-to-end hierarchical FedAvg: root -> edge tiers -> leaf clients,
     one comm group (fabric) per parent/children cell. ``make_group_comm
@@ -542,8 +575,23 @@ def run_tree_fedavg(
     TIER rank at the root — per-tier fold/discard counts, window fill
     times, upload latency (docs/OBSERVABILITY.md "Fleet telemetry") — with
     the same ``rounds``/``totals``/``registry`` shape as the flat runner.
+    ``downlink_codec`` arms the downlink delta plane (compress/downlink.py):
+    the ROOT encodes each round's global once and serves every tier a
+    delta against its echoed version; edge tiers re-serve the chain blob
+    verbatim to their subtree (encode-once per tier, never decoded
+    mid-tree), and leaf clients reconstruct bit-exactly. ``comm_stats``
+    receives the root accountant's per-round/total Comm/* byte records.
     Returns the final global variables (the flat server's return shape)."""
     topo = topology if isinstance(topology, TreeTopology) else TreeTopology(tuple(topology))
+    if downlink_codec is not None:
+        from fedml_tpu.compress.downlink import resolve_downlink_codec
+
+        downlink_codec = resolve_downlink_codec(downlink_codec)
+    if downlink_codec is not None:
+        server_kwargs = {**(server_kwargs or {}),
+                         "downlink_codec": downlink_codec,
+                         "downlink_keyframe_every": downlink_keyframe_every,
+                         "downlink_retention": downlink_retention}
     make_group = make_group_comm or _loopback_group_comm
     fan = topo.fan_ins
     leaf_total = topo.leaf_count
@@ -566,6 +614,10 @@ def run_tree_fedavg(
 
     def _done(r, f):
         results["final"] = f
+        if comm_stats is not None and server.accountant is not None:
+            comm_stats.setdefault("rounds", []).append(
+                server.accountant.round_record(r)
+            )
         if fleet_stats is not None:
             rec = server._fleet_round_record(r)
             if rec is not None:
@@ -628,6 +680,12 @@ def run_tree_fedavg(
         for m in managers:
             if isinstance(m, EdgeAggregatorManager):
                 m.fleet_telemetry = True
+    if downlink_codec is not None:
+        # every leaf decodes with the codec object the root encodes with
+        # (edges pass the chain through untouched)
+        for m in managers:
+            if isinstance(m, FedAvgClientManager):
+                m.downlink_codec = downlink_codec
     threads = [threading.Thread(target=m.run, daemon=True) for m in managers]
     for t in threads:
         t.start()
@@ -658,6 +716,8 @@ def run_tree_fedavg(
                 registry.uninstall()
     for t in threads:
         t.join(timeout=join_timeout)
+    if comm_stats is not None and server.accountant is not None:
+        comm_stats["totals"] = server.accountant.totals()
     return unpack_pytree(results["final"], desc)
 
 

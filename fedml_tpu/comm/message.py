@@ -91,6 +91,12 @@ class Message:
     MSG_ARG_KEY_MODEL_VERSION = "model_version"
     MSG_ARG_KEY_WEIGHT_SUM = "weight_sum"
     MSG_ARG_KEY_FOLD_COUNT = "fold_count"
+    # downlink delta coding (compress/downlink.py, docs/COMPRESSION.md
+    # "Downlink delta coding"): a delta-coded sync's payload reconstructs
+    # the stamped MODEL_VERSION from this base version — a header-only
+    # per-receiver scalar riding FramedMessage overrides, so one shared
+    # delta blob serves a whole fan-out group without re-serialization
+    MSG_ARG_KEY_BASE_VERSION = "base_version"
     # fleet telemetry plane (fedml_tpu/obs/registry.py, docs/OBSERVABILITY.md
     # "Fleet telemetry"): a compact JSON-safe dict of sender-side health
     # metrics piggybacked on ordinary uploads/partials — header-only scalars

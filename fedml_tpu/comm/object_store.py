@@ -130,7 +130,13 @@ class OffloadCommManager(BaseCommunicationManager):
         self.cleanup = cleanup
         # broadcast blobs are shared by all receivers, so the sender retires
         # them: a generation is deleted once `broadcast_generations` newer
-        # fan-outs exist (2 keeps a one-round-stale straggler downloadable)
+        # fan-outs exist (2 keeps a one-round-stale straggler downloadable).
+        # Configurable from the mqtt_s3 runner/CLI (--broadcast_generations),
+        # and raised IN PLACE by the async server when the downlink delta
+        # plane is armed — the floor tracks the observed staleness p99
+        # (compress/downlink.py), so a deliberately slow client's delta-base
+        # blob is still downloadable when it finally fetches. Reads happen
+        # under _bcast_lock at trim time, so a concurrent raise is safe.
         self.broadcast_generations = max(1, int(broadcast_generations))
         self._bcast_lock = threading.Lock()
         self._bcast_gens: list[list[str]] = []  # guarded-by: _bcast_lock

@@ -1,5 +1,6 @@
 """Update-compression subsystem: codecs, error feedback, wire + engine
-integration, and bytes-on-wire accounting (docs/COMPRESSION.md)."""
+integration, downlink delta coding, and bytes-on-wire accounting
+(docs/COMPRESSION.md)."""
 
 from fedml_tpu.compress.codec import (
     Bf16Codec,
@@ -12,15 +13,23 @@ from fedml_tpu.compress.codec import (
     make_codec,
     tree_bytes,
 )
+from fedml_tpu.compress.downlink import (
+    DownlinkCodecState,
+    DownlinkDecoder,
+    resolve_downlink_codec,
+)
 
 __all__ = [
     "Bf16Codec",
     "ChainCodec",
     "Codec",
+    "DownlinkCodecState",
+    "DownlinkDecoder",
     "EncodedUpdate",
     "NoneCodec",
     "QuantizeCodec",
     "TopKCodec",
     "make_codec",
+    "resolve_downlink_codec",
     "tree_bytes",
 ]

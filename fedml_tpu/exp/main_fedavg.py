@@ -193,6 +193,28 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--error_feedback", type=int, default=1,
                         help="carry the codec's dropped mass into the next "
                              "round's update (EF-SGD residual)")
+    # downlink delta coding (fedml_tpu/compress/downlink.py,
+    # docs/COMPRESSION.md "Downlink delta coding")
+    parser.add_argument("--downlink_compressor", type=str, default="none",
+                        help="server->client model distribution codec "
+                             "(none | bf16 | topk | q8 | q4, '+'-chains): "
+                             "each round close is encoded ONCE as a delta "
+                             "against the previous emitted version and "
+                             "served by the version each client echoed; "
+                             "reconstruction is bit-exact. 'none' keeps "
+                             "the dense broadcast bit-identically. "
+                             "Message-passing backends only")
+    parser.add_argument("--downlink_keyframe_every", type=int, default=8,
+                        help="every Nth model version is a dense keyframe "
+                             "(chain reset + lossless resync point)")
+    parser.add_argument("--downlink_retention", type=int, default=4,
+                        help="one-step deltas retained for cumulative "
+                             "chains; the async server raises it from its "
+                             "staleness p99 so slow clients keep a base")
+    parser.add_argument("--broadcast_generations", type=int, default=2,
+                        help="mqtt_s3 object-store fan-out blob retention: "
+                             "a shared broadcast blob is retired once this "
+                             "many newer fan-outs exist")
     # engine knobs
     parser.add_argument("--model_dtype", type=str, default="float32",
                         choices=["float32", "bfloat16"],
@@ -418,6 +440,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             mqtt_host=args.mqtt_host,
             mqtt_port=args.mqtt_port,
             threshold_bytes=args.offload_threshold_bytes,
+            broadcast_generations=getattr(args, "broadcast_generations", 2),
         ),
     }
     codec_kwargs = {}
@@ -497,6 +520,27 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             "error_feedback": bool(args.error_feedback),
             "comm_stats": comm_stats,
         }
+    downlink_kwargs: dict = {}
+    downlink_codec = None
+    if getattr(args, "downlink_compressor", "none") != "none":
+        # downlink delta coding (compress/downlink.py, docs/COMPRESSION.md
+        # "Downlink delta coding"): one encode per round close, serve by
+        # echoed version; 'none' resolves to the unchanged dense broadcast
+        from fedml_tpu.compress.downlink import resolve_downlink_codec
+
+        downlink_codec = resolve_downlink_codec(
+            args.downlink_compressor, topk_frac=args.topk_frac,
+            quantize_bits=args.quantize_bits,
+        )
+    if downlink_codec is not None:
+        kf_every = getattr(args, "downlink_keyframe_every", 8)
+        downlink_kwargs = {
+            "downlink_codec": downlink_codec,
+            "downlink_keyframe_every": kf_every,
+            "downlink_retention": getattr(args, "downlink_retention", 4),
+        }
+        if "comm_stats" not in codec_kwargs and "comm_stats" not in ft_kwargs:
+            downlink_kwargs["comm_stats"] = comm_stats
     overrides = None
     if getattr(args, "init_from", None):
         from fedml_tpu.obs.checkpoint import load_params
@@ -534,6 +578,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         final_variables = run_tree_fedavg_loopback(
             trainer, ds.train, topo, cfg.comm_round, cfg.batch_size,
             seed=cfg.seed, on_round_done=on_round, init_overrides=overrides,
+            **downlink_kwargs,
             **fleet_kwargs,
         )
     else:
@@ -555,6 +600,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             init_overrides=overrides,
             **mobile_kwargs,
             **codec_kwargs,
+            **downlink_kwargs,
             **robust_kwargs,
             **ft_kwargs,
             **mode_kwargs,
@@ -730,6 +776,19 @@ def _run(args) -> list[dict]:
             "message-passing send/liveness planes — there is no wire on "
             "--backend sim; pick --backend loopback|shm|grpc|mqtt_s3"
         )
+    if getattr(args, "downlink_compressor", "none") != "none" \
+            and getattr(args, "is_mobile", 0):
+        raise NotImplementedError(
+            "--downlink_compressor and --is_mobile both redefine the "
+            "downlink wire format; pick one"
+        )
+    if getattr(args, "broadcast_generations", 2) != 2 \
+            and args.backend != "mqtt_s3":
+        raise NotImplementedError(
+            "--broadcast_generations shapes the mqtt_s3 object-store "
+            "blob retention; the other backends keep no broadcast blobs "
+            "— pick --backend mqtt_s3"
+        )
     if (getattr(args, "shard_rules", None)
             or getattr(args, "mesh_shape", None)) and args.backend != "sim":
         raise NotImplementedError(
@@ -780,6 +839,7 @@ def _run(args) -> list[dict]:
         compressor=getattr(args, "compressor", "none"),
         topk_frac=getattr(args, "topk_frac", 0.01),
         quantize_bits=getattr(args, "quantize_bits", 8),
+        downlink_compressor=getattr(args, "downlink_compressor", "none"),
         error_feedback=bool(getattr(args, "error_feedback", 1)),
         profile_dir=args.profile_dir,
     )

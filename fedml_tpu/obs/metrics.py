@@ -31,6 +31,13 @@ COMM_DOWNLINK_BYTES = "Comm/DownlinkBytes"
 COMM_DOWNLINK_DENSE_BYTES = "Comm/DownlinkDenseBytes"
 COMM_RATIO = "Comm/CompressionRatio"
 COMM_DOWNLINK_RATIO = "Comm/DownlinkCompressionRatio"
+# Downlink delta coding (compress/downlink.py, docs/COMPRESSION.md
+# "Downlink delta coding"): how many receivers were served a dense
+# keyframe this round (vs an encoded delta chain). With the plane armed,
+# DownlinkBytes measures the ENCODED payloads actually on the wire
+# (chain blob + descriptor), so DownlinkCompressionRatio is real, not
+# the dense/dense identity it was before the plane existed.
+COMM_DOWNLINK_KEYFRAMES = "Comm/DownlinkKeyframes"
 
 # ratio keys are derived, not additive — totals() must never sum them
 _RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
@@ -95,6 +102,7 @@ class CommBytesAccountant:
         self._up_dense = 0  # guarded-by: _lock
         self._down = 0  # guarded-by: _lock
         self._down_dense = 0  # guarded-by: _lock
+        self._keyframes = 0  # guarded-by: _lock
 
     def record_uplink(self, actual: int, dense: int) -> None:
         with self._lock:
@@ -105,6 +113,13 @@ class CommBytesAccountant:
         with self._lock:
             self._down += int(actual)
             self._down_dense += int(dense)
+
+    def record_keyframes(self, count: int = 1) -> None:
+        """Receivers served a dense keyframe instead of a delta chain
+        (downlink delta plane only — the key is emitted only when the
+        counter moved, so pre-downlink records are unchanged)."""
+        with self._lock:
+            self._keyframes += int(count)
 
     def round_record(self, round_idx: int) -> dict:
         with self._lock:
@@ -119,8 +134,11 @@ class CommBytesAccountant:
                 rec[COMM_RATIO] = self._up_dense / self._up
             if self._down:
                 rec[COMM_DOWNLINK_RATIO] = self._down_dense / self._down
+            if self._keyframes:
+                rec[COMM_DOWNLINK_KEYFRAMES] = self._keyframes
             self.rounds.append(rec)
             self._up = self._up_dense = self._down = self._down_dense = 0
+            self._keyframes = 0
             return rec
 
     def totals(self) -> dict:
@@ -134,6 +152,8 @@ class CommBytesAccountant:
                 COMM_DOWNLINK_BYTES: self._down,
                 COMM_DOWNLINK_DENSE_BYTES: self._down_dense,
             }
+            if self._keyframes:
+                pending[COMM_DOWNLINK_KEYFRAMES] = self._keyframes
             rounds = list(self.rounds)
         for rec in rounds + [pending]:
             for k, v in rec.items():

@@ -132,6 +132,13 @@ class SimConfig:
     compressor: str = "none"
     topk_frac: float = 0.01
     quantize_bits: int = 8
+    # Downlink delta coding (fedml_tpu/compress/downlink.py,
+    # docs/COMPRESSION.md "Downlink delta coding") is a WIRE-PATH plane:
+    # the sim engine broadcasts in-memory views, so there are no downlink
+    # bytes to compress and nothing to delta-code — only "none" (the
+    # bit-identical no-op) is accepted here; any real codec spec fails
+    # loudly at construction instead of silently faking a bytes experiment.
+    downlink_compressor: str = "none"
     # Robust aggregation defense (algorithms/robust.py, docs/ROBUSTNESS.md):
     # clip -> combine (mean/median/trimmed_mean/krum) -> seeded weak-DP
     # noise, run inside the round program. Defaults are the no-defense
@@ -340,6 +347,17 @@ class FedSim:
                     config.robust_rule, config.client_num_per_round,
                     c_pad - config.client_num_per_round, n_dev,
                 )
+        if (config.downlink_compressor
+                and config.downlink_compressor != "none"):
+            raise ValueError(
+                f"downlink_compressor={config.downlink_compressor!r}: "
+                "downlink delta coding is a wire-path plane "
+                "(compress/downlink.py) — the sim engine broadcasts "
+                "in-memory views, so there are no downlink bytes to "
+                "compress; run a message-passing backend "
+                "(loopback/shm/grpc/mqtt_s3), or 'none' for the "
+                "bit-identical sim path"
+            )
         if config.compressor and config.compressor != "none":
             from fedml_tpu.compress import make_codec
             from fedml_tpu.compress.aggregate import compressed_aggregator

@@ -1,0 +1,357 @@
+"""Downlink delta coding (fedml_tpu/compress/downlink.py, docs/COMPRESSION.md
+"Downlink delta coding"): codec resolution, server-state keyframe/chain/
+retention semantics, client-side bit-exact reconstruction and its defect
+guards, engine/runner composition rules, the hierarchical tree pass-through,
+and the tier-1 smoke."""
+
+import json
+
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.comm.message import pack_pytree
+from fedml_tpu.compress import make_codec
+from fedml_tpu.compress.downlink import (
+    DownlinkCodecState,
+    DownlinkDecoder,
+    resolve_downlink_codec,
+)
+
+
+def _fixture(dim=24, seed=3):
+    rng = np.random.RandomState(seed)
+    tree = {"w": rng.randn(dim, 4).astype(np.float32),
+            "b": rng.randn(4).astype(np.float32)}
+    flat, desc = pack_pytree(tree)
+    return flat, desc, rng
+
+
+def _f32(u8):
+    return np.array(np.ascontiguousarray(np.asarray(u8)).view(np.float32))
+
+
+# ---------------------------------------------------------------------------
+# resolution
+# ---------------------------------------------------------------------------
+
+
+def test_resolve_none_is_dense_path():
+    assert resolve_downlink_codec(None) is None
+    assert resolve_downlink_codec("none") is None
+    assert resolve_downlink_codec("  none ") is None
+    assert resolve_downlink_codec(make_codec("none")) is None
+
+
+def test_resolve_specs_and_instances():
+    assert resolve_downlink_codec("q8").name == "q8"
+    assert resolve_downlink_codec("topk+q4", topk_frac=0.1).name == "topk0.1+q4"
+    codec = make_codec("bf16")
+    assert resolve_downlink_codec(codec) is codec
+
+
+def test_state_rejects_none_codec():
+    flat, desc, _ = _fixture()
+    with pytest.raises(ValueError, match="delta-domain"):
+        DownlinkCodecState(make_codec("none"), desc)
+
+
+# ---------------------------------------------------------------------------
+# server state: keyframes, chains, retention
+# ---------------------------------------------------------------------------
+
+
+def test_advance_returns_decoded_and_fresh_chain_reconstructs():
+    flat, desc, rng = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc, keyframe_every=100,
+                               retention=8)
+    client = DownlinkDecoder(make_codec("q8"))
+    # the decoder must use the SAME codec object as the server in real
+    # runs; a same-spec clone is fine for decode (deterministic program)
+    client.apply_keyframe(state.reset(flat, 0), 0)
+    decoded_prev = _f32(flat)
+    for v in range(1, 5):
+        new = decoded_prev + rng.randn(decoded_prev.size).astype(np.float32)
+        out = _f32(state.advance(new.view(np.uint8), v))
+        # q8 is lossy: decoded != raw aggregate, but the delta was formed
+        # against the DECODED base so the error is one round's, not
+        # accumulated
+        assert not np.array_equal(out, new)
+        kind, blob, cdesc = state.serve(client.version)
+        assert kind == "delta"
+        client.apply_chain(blob, cdesc, client.version, v)
+        np.testing.assert_array_equal(client.held, out)
+        decoded_prev = out
+
+
+def test_keyframe_cadence_resets_chain_and_is_exact():
+    flat, desc, rng = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc, keyframe_every=3,
+                               retention=8)
+    state.reset(flat, 0)
+    base = _f32(flat)
+    state.advance((base + 1).view(np.uint8), 1)
+    state.advance((base + 2).view(np.uint8), 2)
+    out = _f32(state.advance((base + 3).view(np.uint8), 3))  # 3 % 3 == 0
+    # keyframe versions snap decoded back to the EXACT aggregate
+    np.testing.assert_array_equal(out, base + 3)
+    # and reset the chain: a base from before the keyframe gets a dense
+    # resync (designed cadence, NOT flagged as retired)
+    kind, reason, retired = state.serve(2)
+    assert kind == "keyframe" and not retired, (kind, reason)
+    s = state.stats_snapshot()
+    assert s["keyframes"] == 2 and s["deltas"] == 2
+
+
+def test_cumulative_chain_shares_one_blob_per_gap():
+    flat, desc, rng = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc, keyframe_every=100,
+                               retention=8)
+    state.reset(flat, 0)
+    base = _f32(flat)
+    for v in range(1, 4):
+        state.advance((base + v).view(np.uint8), v)
+    k1, blob1, d1 = state.serve(1)
+    k2, blob2, d2 = state.serve(1)
+    assert k1 == k2 == "delta"
+    assert blob1 is blob2 and d1 is d2  # cached: one blob per distinct gap
+    steps = json.loads(d1)["steps"]
+    assert [s["version"] for s in steps] == [2, 3]
+
+
+def test_retention_trims_and_flags_retired():
+    flat, desc, rng = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc, keyframe_every=100,
+                               retention=2)
+    state.reset(flat, 0)
+    base = _f32(flat)
+    for v in range(1, 5):
+        state.advance((base + v).view(np.uint8), v)
+    # base 0 needs steps 1..4 but only 3,4 are retained -> retired fallback
+    kind, reason, retired = state.serve(0)
+    assert kind == "keyframe" and retired, (kind, reason)
+    assert "retired" in reason
+    # base 2 is still covered
+    assert state.serve(2)[0] == "delta"
+    assert state.stats_snapshot()["retired_fallbacks"] == 1
+
+
+def test_staleness_p99_raises_retention_floor():
+    flat, desc, rng = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc, keyframe_every=1000,
+                               retention=1)
+    state.reset(flat, 0)
+    base = _f32(flat)
+    assert state.retention_effective() == 1  # nothing observed yet
+    for _ in range(50):
+        state.observe_staleness(3)
+    # observed p99 lag 3 -> keep 4 steps, despite retention=1
+    assert state.retention_effective() == 4
+    for v in range(1, 7):
+        state.advance((base + v).view(np.uint8), v)
+    assert state.retention_effective() == 4
+    assert state.serve(2)[0] == "delta"  # gap 4: covered by the floor
+    # the floor never shrinks, even if later draws are fresh
+    for _ in range(5000):
+        state.observe_staleness(1)
+    state.advance((base + 7).view(np.uint8), 7)
+    assert state.retention_effective() == 4
+
+
+def test_serve_current_or_unknown_base_is_keyframe():
+    flat, desc, _ = _fixture()
+    state = DownlinkCodecState(make_codec("q8"), desc)
+    state.reset(flat, 0)
+    kind, _, retired = state.serve(None)
+    assert kind == "keyframe" and not retired
+    kind, _, retired = state.serve(0)  # already current
+    assert kind == "keyframe" and not retired
+
+
+# ---------------------------------------------------------------------------
+# client decoder defect guards
+# ---------------------------------------------------------------------------
+
+
+def _one_step_chain(state, base):
+    kind, blob, desc = state.serve(base)
+    assert kind == "delta"
+    return blob, desc
+
+
+def test_decoder_guards():
+    flat, desc, rng = _fixture()
+    codec = make_codec("q8")
+    state = DownlinkCodecState(codec, desc, keyframe_every=100, retention=8)
+    state.reset(flat, 0)
+    base = _f32(flat)
+    state.advance((base + 1).view(np.uint8), 1)
+    state.advance((base + 2).view(np.uint8), 2)
+    blob, cdesc = _one_step_chain(state, 1)  # step 2 only
+
+    fresh = DownlinkDecoder(codec)
+    with pytest.raises(RuntimeError, match="before any keyframe"):
+        fresh.apply_chain(blob, cdesc, 1, 2)
+
+    held0 = DownlinkDecoder(codec)
+    held0.apply_keyframe(flat, 0)  # version 0
+    with pytest.raises(RuntimeError, match="missing step"):
+        # no base header: the continuity check itself catches the gap
+        held0.apply_chain(blob, cdesc, None, 2)  # needs step 1 first
+
+    ahead = DownlinkDecoder(codec)
+    ahead.apply_keyframe(flat, 0)
+    with pytest.raises(RuntimeError, match="ahead of the held version"):
+        ahead.apply_chain(blob, cdesc, 1, 2)
+
+    wrong = DownlinkDecoder(make_codec("q4"))
+    wrong.apply_keyframe(flat, 1)
+    with pytest.raises(RuntimeError, match="same --downlink_compressor"):
+        wrong.apply_chain(blob, cdesc, 1, 2)
+
+    bad_kind = DownlinkDecoder(codec)
+    bad_kind.apply_keyframe(flat, 1)
+    mangled = json.dumps({**json.loads(cdesc), "kind": "nonsense"})
+    with pytest.raises(RuntimeError, match="misrouted"):
+        bad_kind.apply_chain(blob, mangled, 1, 2)
+
+
+def test_decoder_skips_already_held_steps():
+    """The server may serve a chain from an older echo than the client's
+    true state — steps at or below the held version are skipped and the
+    result is still bit-exact."""
+    flat, desc, rng = _fixture()
+    codec = make_codec("q8")
+    state = DownlinkCodecState(codec, desc, keyframe_every=100, retention=8)
+    client = DownlinkDecoder(codec)
+    client.apply_keyframe(state.reset(flat, 0), 0)
+    base = _f32(flat)
+    state.advance((base + 1).view(np.uint8), 1)
+    kind, blob, cdesc = state.serve(0)
+    client.apply_chain(blob, cdesc, 0, 1)  # now holds 1
+    out = _f32(state.advance((base + 2).view(np.uint8), 2))
+    kind, blob, cdesc = state.serve(0)  # server still thinks base 0
+    client.apply_chain(blob, cdesc, 0, 2)  # step 1 skipped, step 2 applied
+    np.testing.assert_array_equal(client.held, out)
+    assert client.version == 2
+
+
+# ---------------------------------------------------------------------------
+# engine / runner composition rules
+# ---------------------------------------------------------------------------
+
+
+def test_sim_engine_rejects_real_downlink_codec():
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    train, test = gaussian_blobs(n_clients=4, samples_per_client=16, seed=0)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.1), epochs=1)
+    cfg = SimConfig(client_num_in_total=4, client_num_per_round=4,
+                    comm_round=1, downlink_compressor="q8")
+    with pytest.raises(ValueError, match="wire-path plane"):
+        FedSim(trainer, train, test, cfg)
+    # "none" is the accepted bit-identical no-op
+    FedSim(trainer, train, test, SimConfig(
+        client_num_in_total=4, client_num_per_round=4, comm_round=1,
+        downlink_compressor="none"))
+
+
+def test_runner_rejects_downlink_with_custom_managers():
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        FedAvgServerManager,
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=2, samples_per_client=8, seed=0)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.1), epochs=1)
+    with pytest.raises(ValueError, match="custom manager classes"):
+        run_distributed_fedavg_loopback(
+            trainer, train, worker_num=2, round_num=1, batch_size=4,
+            downlink_codec="q8", server_cls=FedAvgServerManager,
+        )
+
+
+# ---------------------------------------------------------------------------
+# hierarchical tree pass-through
+# ---------------------------------------------------------------------------
+
+
+def _tree_fixture():
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=4, samples_per_client=16, seed=9)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=4),
+                            optimizer=optax.sgd(0.2), epochs=1)
+    return trainer, train
+
+
+def test_tree_downlink_keyframe_oracle_bitwise():
+    """keyframe_every=1 (all dense keyframes) through the tree: the version
+    stamps and edge pass-through must not perturb training — bit-identical
+    to the dense tree run."""
+    import jax
+
+    from fedml_tpu.async_agg.tree import run_tree_fedavg_loopback
+
+    trainer, train = _tree_fixture()
+
+    def run(**kwargs):
+        return run_tree_fedavg_loopback(trainer, train, (2, 2), 2, 8,
+                                        **kwargs)
+
+    dense = run()
+    kf = run(downlink_codec=make_codec("q8"), downlink_keyframe_every=1)
+    for a, b in zip(jax.tree.leaves(dense), jax.tree.leaves(kf)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_tree_downlink_delta_chains_reach_leaves():
+    """Real q8 deltas through a 2-tier tree: edges re-serve the chain
+    verbatim, leaves reconstruct, the run completes, and the root actually
+    served encoded chains (comm_stats shows encoded downlink bytes)."""
+    from fedml_tpu.async_agg.tree import run_tree_fedavg_loopback
+    from fedml_tpu.obs import metrics as metricslib
+
+    trainer, train = _tree_fixture()
+    comm: dict = {}
+    run_tree_fedavg_loopback(
+        trainer, train, (2, 2), 3, 8,
+        downlink_codec=make_codec("q8"), downlink_keyframe_every=64,
+        comm_stats=comm,
+    )
+    totals = comm["totals"]
+    assert totals[metricslib.COMM_DOWNLINK_BYTES] > 0
+    # steady-state rounds served chains, not keyframes
+    delta_rounds = [r for r in comm["rounds"]
+                    if metricslib.COMM_DOWNLINK_KEYFRAMES not in r]
+    assert delta_rounds, comm["rounds"]
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke
+# ---------------------------------------------------------------------------
+
+
+def test_downlink_smoke_tool_runs():
+    """tools/downlink_smoke.py is the tier-1 guard the docs point at — the
+    none-arm bit-identity, scripted reconstruction, deliberately stale
+    async client, and object-store >=10x arms — run in-process (mirrors
+    the wire/async smokes' wiring)."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "downlink_smoke.py"
+    spec = importlib.util.spec_from_file_location("downlink_smoke", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main([]) == 0

@@ -562,3 +562,171 @@ def test_wire_smoke_tool_runs():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# base-version header overrides (downlink delta plane) across backends
+# ---------------------------------------------------------------------------
+# The delta downlink serves ONE shared chain blob per version-gap and varies
+# ONLY the per-receiver base-version header — the slot-patch/override path
+# must never densify or re-serialize the shared payload on any backend.
+
+
+def _delta_style_message():
+    msg = Message(2, 0, 1)
+    chain = np.arange(256, dtype=np.uint8)
+    msg.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE, chain)
+    msg.add_params(Message.MSG_ARG_KEY_ENCODED_DESC,
+                   '{"kind": "downlink_delta_chain", "steps": []}')
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_VERSION, 9)
+    return msg, chain
+
+
+def _base_overrides(receivers):
+    return {r: {Message.MSG_ARG_KEY_BASE_VERSION: 5 + r} for r in receivers}
+
+
+def _collect_broadcast(sender, receivers, stop_attr=None):
+    """Broadcast a delta-style message and return {rank: Message} received.
+    ``stop_attr`` names the manager to stop when the receive loop should
+    unblock (defaults to the receiver manager itself)."""
+    received: dict[int, Message] = {}
+    threads = []
+
+    class Obs:
+        def __init__(self, rank, mgr):
+            self.rank, self.mgr = rank, mgr
+
+        def receive_message(self, t, m):
+            received[self.rank] = m
+            (self.mgr if stop_attr is None
+             else getattr(self.mgr, stop_attr)).stop_receive_message()
+
+    for r, mgr in receivers.items():
+        mgr.add_observer(Obs(r, mgr))
+        th = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+        th.start()
+        threads.append(th)
+    msg, chain = _delta_style_message()
+    reset_wire_stats()
+    sender.broadcast_message(msg, sorted(receivers),
+                             per_receiver=_base_overrides(receivers))
+    for th in threads:
+        th.join(timeout=15)
+    return received, chain
+
+
+def _assert_base_version_delivery(received, chain, expect_ranks):
+    assert sorted(received) == sorted(expect_ranks), sorted(received)
+    for r, got in received.items():
+        assert got.get(Message.MSG_ARG_KEY_BASE_VERSION) == 5 + r, (
+            r, got.get(Message.MSG_ARG_KEY_BASE_VERSION)
+        )
+        assert got.get(Message.MSG_ARG_KEY_MODEL_VERSION) == 9
+        assert got.get(Message.MSG_ARG_KEY_ENCODED_DESC) == (
+            '{"kind": "downlink_delta_chain", "steps": []}'
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got.get(Message.MSG_ARG_KEY_ENCODED_UPDATE)), chain
+        )
+
+
+def test_base_version_override_loopback_shares_payload():
+    fabric = LoopbackFabric(4)
+    mgrs = {r: LoopbackCommManager(fabric, r) for r in range(4)}
+    received, chain = _collect_broadcast(mgrs[0],
+                                         {r: mgrs[r] for r in (1, 2, 3)})
+    assert wire_stats()["payload_serializations"] == 1  # encode-once held
+    _assert_base_version_delivery(received, chain, (1, 2, 3))
+    # per-receiver headers vary, the payload buffer is ONE shared view
+    assert np.shares_memory(
+        np.asarray(received[1].get(Message.MSG_ARG_KEY_ENCODED_UPDATE)),
+        np.asarray(received[2].get(Message.MSG_ARG_KEY_ENCODED_UPDATE)),
+    )
+    for r in (1, 2, 3):
+        arr = received[r].get(Message.MSG_ARG_KEY_ENCODED_UPDATE)
+        assert not arr.flags.writeable
+
+
+def test_base_version_override_mqtt_inproc():
+    from fedml_tpu.comm.inproc_broker import InProcessBroker
+    from fedml_tpu.comm.mqtt_backend import MqttCommManager
+
+    factory = InProcessBroker().client_factory()
+    server = MqttCommManager("inproc", 0, topic="bv", client_id=0,
+                             client_num=2, client_factory=factory)
+    clients = {
+        r: MqttCommManager("inproc", 0, topic="bv", client_id=r,
+                           client_num=2, client_factory=factory)
+        for r in (1, 2)
+    }
+    msg, chain = _delta_style_message()
+    reset_wire_stats()
+    server.broadcast_message(msg, [1, 2], per_receiver=_base_overrides(clients))
+    assert wire_stats()["payload_serializations"] == 1
+    for r, c in clients.items():
+        got = c._q.get(timeout=5)
+        assert got.get(Message.MSG_ARG_KEY_BASE_VERSION) == 5 + r
+        np.testing.assert_array_equal(
+            np.asarray(got.get(Message.MSG_ARG_KEY_ENCODED_UPDATE)), chain)
+    for m in [server, *clients.values()]:
+        m.stop_receive_message()
+
+
+def test_base_version_override_object_store_single_put(tmp_path):
+    """One blob put per fan-out GROUP even with per-receiver base headers —
+    the store path must share the payload exactly like the framed path."""
+    from fedml_tpu.comm.object_store import FileSystemStore, OffloadCommManager
+
+    puts = []
+
+    class CountingStore(FileSystemStore):
+        def put(self, key, data):
+            puts.append(key)
+            super().put(key, data)
+
+    store = CountingStore(tmp_path / "store")
+    fabric = LoopbackFabric(3)
+    mgrs = {
+        r: OffloadCommManager(LoopbackCommManager(fabric, r), store,
+                              threshold_bytes=64)
+        for r in range(3)
+    }
+    received, chain = _collect_broadcast(
+        mgrs[0], {r: mgrs[r] for r in (1, 2)}, stop_attr="inner")
+    assert len(puts) == 1, puts  # one blob for the whole fan-out
+    _assert_base_version_delivery(received, chain, (1, 2))
+
+
+def test_base_version_override_shm():
+    from fedml_tpu.comm.shm import ShmCommManager
+
+    job = f"fedml_bv{np.random.randint(1 << 30)}"
+    mgrs = {r: ShmCommManager(job, r, 3, capacity=1 << 20) for r in range(3)}
+    try:
+        received, chain = _collect_broadcast(mgrs[0],
+                                             {r: mgrs[r] for r in (1, 2)})
+        assert wire_stats()["payload_serializations"] == 1
+        _assert_base_version_delivery(received, chain, (1, 2))
+    finally:
+        for m in mgrs.values():
+            m.cleanup()
+
+
+def test_base_version_override_grpc():
+    pytest.importorskip("grpc")
+    from tests.test_comm import _free_port_run
+
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    base = _free_port_run(3)
+    cfg = {r: ("127.0.0.1", base + r) for r in range(3)}
+    mgrs = {r: GRPCCommManager(r, cfg) for r in range(3)}
+    try:
+        received, chain = _collect_broadcast(mgrs[0],
+                                             {r: mgrs[r] for r in (1, 2)})
+        assert wire_stats()["payload_serializations"] == 1
+        _assert_base_version_delivery(received, chain, (1, 2))
+    finally:
+        for m in mgrs.values():
+            m.stop_receive_message()

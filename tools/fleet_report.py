@@ -136,6 +136,10 @@ def summarize(view: dict, rounds: int = 0) -> dict:
             "pop_predicted_steps": g.get("pop_predicted_steps"),
             "pop_actual_steps": g.get("pop_actual_steps"),
             "pop_dropped_uploads": g.get("pop_dropped_uploads"),
+            # downlink delta plane (compress/downlink.py): cumulative
+            # ENCODED bytes the server actually sent this rank — present
+            # only when --downlink_compressor armed the plane
+            "downlink_bytes": g.get("downlink_bytes"),
             "gauges": dict(g),
             # every histogram the rank carries, not just the three fleet-
             # wide ones (a tree root's per-tier "folds" distribution lives
@@ -204,6 +208,17 @@ def format_text(report: dict) -> str:
             f"{_na(r['upload_ms_p50']):>9} {_na(r['upload_ms_p99']):>9} "
             f"{_na(r['staleness_mean']):>9} {_na(r['staleness_max'], '{:g}'):>5}"
         )
+    downlink = [r for r in report["per_rank"]
+                if r.get("downlink_bytes") is not None]
+    if downlink:
+        lines += [
+            "",
+            "downlink delta plane (cumulative encoded bytes actually sent "
+            "per rank — compress/downlink.py):",
+            f"{'rank':>4} {'downlink bytes':>14}",
+        ]
+        for r in downlink:
+            lines.append(f"{r['rank']:>4} {r['downlink_bytes']:>14g}")
     churn = [r for r in report["per_rank"]
              if r.get("pop_predicted_steps") is not None]
     if churn:
