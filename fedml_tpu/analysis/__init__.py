@@ -24,7 +24,7 @@ over ``fedml_tpu/`` and ``tools/`` (tests/test_static_analysis.py).
 
 from fedml_tpu.analysis.config import FedlintConfig, load_config
 from fedml_tpu.analysis.core import Finding, Project, Rule, Waiver, run_analysis
-from fedml_tpu.analysis.report import render_json, render_text
+from fedml_tpu.analysis.report import render_json, render_sarif, render_text
 from fedml_tpu.analysis.rules import all_rules, make_rules
 
 __all__ = [
@@ -37,6 +37,7 @@ __all__ = [
     "load_config",
     "make_rules",
     "render_json",
+    "render_sarif",
     "render_text",
     "run_analysis",
 ]

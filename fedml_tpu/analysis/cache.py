@@ -1,0 +1,99 @@
+"""Incremental facts cache: one JSON sidecar under ``.fedlint_cache/``.
+
+The tier-1 zero-findings gate re-analyzes the whole tree on every run, and
+the suite already sits near its timeout budget — parsing + extraction is
+the dominant cost for files that have not changed since the last run. The
+cache keys each file's serialized :class:`~fedml_tpu.analysis.facts.FileFacts`
+on ``(path, mtime_ns, size)``: a warm run loads facts straight from JSON and
+never re-parses an unchanged file, while ANY content change (mtime or size
+moves) falls back to a fresh parse+extract. Because extraction is
+config-independent (see facts.py), one cache serves every rule selection.
+
+Safety properties:
+
+- the whole sidecar is versioned on ``FACTS_SCHEMA_VERSION`` — a schema or
+  extraction-semantics change discards the cache wholesale, never mixing
+  old and new facts;
+- writes are atomic (tmp + ``os.replace``), so a crash mid-save leaves the
+  previous sidecar intact;
+- a corrupt/unreadable sidecar degrades to an empty cache (cold run), never
+  to an error.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from fedml_tpu.analysis.facts import FACTS_SCHEMA_VERSION, FileFacts
+
+_SIDECAR = "facts.json"
+
+
+class FactsCache:
+    """``(path, mtime_ns, size)``-keyed FileFacts store in one JSON file."""
+
+    def __init__(self, directory: str | Path):
+        self.directory = Path(directory)
+        self.sidecar = self.directory / _SIDECAR
+        self._entries: dict[str, dict] = {}
+        # paths served or stored THIS run: save() prunes everything else,
+        # so deleted/renamed files never accumulate dead entries
+        self._seen: set[str] = set()
+        self._dirty = False
+        self.hits = 0
+        self.misses = 0
+        try:
+            doc = json.loads(self.sidecar.read_text())
+            if doc.get("version") == FACTS_SCHEMA_VERSION:
+                self._entries = doc.get("entries", {})
+        except (OSError, ValueError):
+            self._entries = {}
+
+    def get(self, path: str, mtime_ns: int, size: int) -> FileFacts | None:
+        self._seen.add(path)
+        entry = self._entries.get(path)
+        if (entry is None or entry.get("mtime") != mtime_ns
+                or entry.get("size") != size):
+            self.misses += 1
+            return None
+        try:
+            facts = FileFacts.from_dict(entry["facts"])
+        except (KeyError, TypeError, ValueError):
+            # entry shape drifted (hand-edited / truncated): treat as miss
+            self.misses += 1
+            del self._entries[path]
+            self._dirty = True
+            return None
+        self.hits += 1
+        return facts
+
+    def put(self, path: str, mtime_ns: int, size: int,
+            facts: FileFacts) -> None:
+        self._seen.add(path)
+        self._entries[path] = {
+            "mtime": mtime_ns, "size": size, "facts": facts.to_dict(),
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        """Atomically persist the sidecar, pruned to the files this run
+        actually scanned (no-op when nothing changed). A narrower scan
+        (explicit CLI paths) shrinks the sidecar to its scope — cheap to
+        repopulate — rather than letting dead entries grow it forever."""
+        stale = set(self._entries) - self._seen
+        if stale:
+            for path in stale:
+                del self._entries[path]
+            self._dirty = True
+        if not self._dirty:
+            return
+        self.directory.mkdir(parents=True, exist_ok=True)
+        tmp = self.sidecar.with_suffix(".json.tmp")
+        tmp.write_text(json.dumps({
+            "version": FACTS_SCHEMA_VERSION,
+            "entries": self._entries,
+        }))
+        os.replace(tmp, self.sidecar)
+        self._dirty = False

@@ -18,6 +18,9 @@ DEFAULT_RULES = (
     "wire-contract",
     "traced-purity",
     "metric-keys",
+    "lock-order",
+    "blocking-under-lock",
+    "thread-entry",
 )
 
 
@@ -33,6 +36,13 @@ class FedlintConfig:
     # fedlint: disable=metric-keys -- the prefix grammar the rule enforces, not record keys
     metric_prefixes: tuple[str, ...] = ("Comm/", "Robust/", "Async/", "Fleet/")
     metric_modules: tuple[str, ...] = ("fedml_tpu/obs/metrics.py",)
+    # metric-keys dead-metric arm: the tools that CONSUME the canonical
+    # keys, and the docs trees whose tables count as consumers — a key no
+    # emitter references, or one no reader/doc names, is a finding
+    metric_reader_modules: tuple[str, ...] = (
+        "tools/fleet_report.py", "tools/trace_report.py",
+    )
+    metric_doc_paths: tuple[str, ...] = ("docs",)
     # traced-purity: banned host-call patterns inside lowered functions
     banned_traced_calls: tuple[str, ...] = (
         "time.time", "np.random.*", "numpy.random.*", "print",
@@ -48,6 +58,23 @@ class FedlintConfig:
         "fedml_tpu/population/:np.random.*",
         "fedml_tpu/population/:numpy.random.*",
     )
+    # blocking-under-lock: fnmatch patterns over the dotted call chain
+    # ("a.b.c"); a match is a call that can block the thread — banned while
+    # any lock is held along the call chain (PR 8 "checkpoint written
+    # outside the lock", PR 11 "trace events emitted after release").
+    # A `.wait` on the HELD lock itself is exempt in-rule (Condition.wait
+    # releases it).
+    blocking_calls: tuple[str, ...] = (
+        "time.sleep",
+        "np.savez*", "numpy.savez*", "json.dump", "pickle.dump",
+        "*.send_message", "*.broadcast_message", "*.send_init_msg",
+        "*.run_all", "*.save_server",
+        "*.result", "*.wait", "*.join",
+    )
+    # lock-order / thread-entry: lock-name aliases, "<from>=<to>" — merges
+    # two attr spellings (or two qualified Class.attr ids) that reference
+    # ONE runtime lock object, so the acquisition graph sees one node
+    lock_aliases: tuple[str, ...] = ()
 
 
 def _parse_fallback(text: str) -> dict:
@@ -116,8 +143,13 @@ def load_config(start: str | Path | None = None) -> FedlintConfig:
         exclude=tup("exclude", defaults.exclude),
         metric_prefixes=tup("metric-prefixes", defaults.metric_prefixes),
         metric_modules=tup("metric-modules", defaults.metric_modules),
+        metric_reader_modules=tup("metric-reader-modules",
+                                  defaults.metric_reader_modules),
+        metric_doc_paths=tup("metric-doc-paths", defaults.metric_doc_paths),
         banned_traced_calls=tup("banned-traced-calls",
                                 defaults.banned_traced_calls),
         banned_module_calls=tup("banned-module-calls",
                                 defaults.banned_module_calls),
+        blocking_calls=tup("blocking-calls", defaults.blocking_calls),
+        lock_aliases=tup("lock-aliases", defaults.lock_aliases),
     )

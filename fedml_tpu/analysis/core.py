@@ -1,12 +1,34 @@
-"""fedlint core: shared AST walk, cross-file project index, waivers.
+"""fedlint core: shared facts extraction, whole-program index, waivers.
 
-One :class:`SourceFile` per ``.py`` file carries the parsed tree plus the
-comment map (extracted with :mod:`tokenize`, so ``#`` inside string
-literals never reads as an annotation). Rules run in two passes —
-``collect`` (per file, builds cross-file state) then ``check``/``finalize``
-(emit findings) — so contracts that span files (wire keys written in one
-module and read in another, lock annotations inherited across the class
-diamond) need no per-rule file ordering.
+v1 gave each rule the raw per-file AST; v2 runs ONE extraction pass per
+file (:mod:`fedml_tpu.analysis.facts`) and hands every rule the same
+JSON-serializable :class:`~fedml_tpu.analysis.facts.FileFacts` — which is
+also what the incremental cache (:mod:`fedml_tpu.analysis.cache`) persists,
+so a warm run never re-parses an unchanged file. Rules still run in two
+passes — ``collect`` (per file, builds cross-file state) then
+``check``/``finalize`` (emit findings) — so contracts that span files (wire
+keys written in one module and read in another, lock annotations inherited
+across the class diamond) need no per-rule file ordering.
+
+On top of the per-class index, :class:`Project` now carries the
+whole-program machinery the concurrency rules need:
+
+- a function/method index covering methods, module-level functions, nested
+  defs, and lambdas;
+- call-graph resolution for ``self.<m>()`` (through the class diamond,
+  nearest override first), bare-name calls (nested defs in enclosing
+  scopes, then module-level functions), with everything else — dynamic
+  dispatch, ``getattr``, calls on non-``self`` objects — left UNRESOLVED by
+  design (documented limit: the analysis under-approximates the call
+  graph, it never guesses);
+- the thread-entry set: callables handed to ``threading.Thread`` /
+  ``threading.Timer`` / pool dispatch (``run_all``/``submit``), which run
+  later with no locks held;
+- lock identity: ``with self.<attr>:`` sites are qualified to the ROOT-most
+  class in the hierarchy whose ``__init__`` assigns the attr, so a base's
+  lock and a subclass's acquisition of it are the same node in the
+  lock-order graph (``[tool.fedlint] lock-aliases`` can merge attr
+  spellings that alias one runtime lock).
 
 Waivers: ``# fedlint: disable=<rule>[,<rule>...] -- <justification>`` on
 the finding's line (or a standalone comment on the line above) suppresses
@@ -24,19 +46,19 @@ import re
 import tokenize
 from pathlib import Path
 
+from fedml_tpu.analysis.facts import (
+    ClassFact,
+    FileFacts,
+    FuncFact,
+    extract_facts,
+)
+
 # annotation / directive comment grammar (docs/STATIC_ANALYSIS.md)
 _WAIVER_RE = re.compile(
     r"#\s*fedlint:\s*disable=([\w\-,\s]+?)(?:\s*--\s*(.+))?\s*$"
 )
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w]+)")
 _LOCK_HELD_RE = re.compile(r"#\s*lock-held:\s*([\w,\s]+)")
-
-# builtin coercions are value plumbing, not construction: a subclass
-# re-coercing `self.x = bool(x)` is not the construct-then-overwrite seam
-_COERCIONS = frozenset({
-    "bool", "int", "float", "str", "bytes", "tuple", "list", "dict", "set",
-    "frozenset",
-})
 
 
 @dataclasses.dataclass
@@ -87,7 +109,11 @@ class Waiver:
 
 
 class SourceFile:
-    """A parsed module: tree + per-line comments + waiver directives."""
+    """A parsed module: tree + per-line comments + waiver directives.
+
+    Exists only on the COLD path — :func:`run_analysis` parses a file into
+    a SourceFile, extracts its :class:`~fedml_tpu.analysis.facts.FileFacts`,
+    and from then on every rule (and the cache) sees facts only."""
 
     def __init__(self, path: str, text: str):
         self.path = path
@@ -144,164 +170,87 @@ class SourceFile:
                 return m.group(1)
         return None
 
-    def waiver_for(self, rule: str, line: int) -> Waiver | None:
-        """Waiver applying to a finding of ``rule`` at ``line``: same line,
-        or a standalone directive comment on the line directly above."""
-        for candidate in (line, line - 1):
-            w = self.waivers.get(candidate)
-            if w is None:
-                continue
-            if candidate == line - 1 and candidate not in self.standalone_comments:
-                continue
-            if rule in w.rules:
-                return w
-        return None
-
 
 @dataclasses.dataclass
-class ClassInfo:
-    """Per-class facts the cross-file rules need: the base-name chain, what
-    ``__init__`` constructs, and the concurrency annotations."""
+class ClassView:
+    """One class definition: its facts plus the file that holds them."""
 
-    name: str
-    bases: tuple[str, ...]
-    file: SourceFile
-    node: ast.ClassDef
-    init_node: ast.FunctionDef | None = None
-    # attrs `self.X = <call>`-constructed in __init__ -> assignment line
-    init_constructed: dict[str, int] = dataclasses.field(default_factory=dict)
-    # every `self.X = ...` in __init__ (constructed or not)
-    init_assigned: set[str] = dataclasses.field(default_factory=set)
-    # first line of the `super().__init__(...)` call in __init__, if any
-    super_call_line: int | None = None
-    # `# guarded-by:` declarations: attr -> lock name
-    guarded: dict[str, str] = dataclasses.field(default_factory=dict)
-    # lines carrying a guarded-by declaration (the declaration is exempt)
-    guard_decl_lines: set[int] = dataclasses.field(default_factory=set)
-    # `# lock-held:` method annotations: method name -> lock names
-    lock_held: dict[str, tuple[str, ...]] = dataclasses.field(
-        default_factory=dict
-    )
+    facts: ClassFact
+    file: FileFacts
 
+    @property
+    def name(self) -> str:
+        return self.facts.name
 
-def _base_name(expr: ast.expr) -> str | None:
-    if isinstance(expr, ast.Name):
-        return expr.id
-    if isinstance(expr, ast.Attribute):
-        return expr.attr
-    return None
+    @property
+    def bases(self) -> tuple[str, ...]:
+        return self.facts.bases
 
+    @property
+    def guarded(self) -> dict[str, str]:
+        return self.facts.guarded
 
-def _self_attr_target(node: ast.stmt) -> str | None:
-    """`self.X = ...` / `self.X: T = ...` -> X (single-target only)."""
-    if isinstance(node, ast.Assign) and len(node.targets) == 1:
-        target = node.targets[0]
-    elif isinstance(node, ast.AnnAssign):
-        target = node.target
-    else:
-        return None
-    if (isinstance(target, ast.Attribute)
-            and isinstance(target.value, ast.Name)
-            and target.value.id == "self"):
-        return target.attr
-    return None
-
-
-def _is_construction(value: ast.expr | None) -> bool:
-    """True for `self.X = <call>` where the call is a real construction
-    (not a builtin coercion of an argument)."""
-    if not isinstance(value, ast.Call):
-        return False
-    func = value.func
-    if isinstance(func, ast.Name) and func.id in _COERCIONS:
-        return False
-    return True
-
-
-def _is_super_init_call(node: ast.stmt) -> bool:
-    """`super().__init__(...)` or `SomeClass.__init__(self, ...)`."""
-    if not (isinstance(node, ast.Expr) and isinstance(node.value, ast.Call)):
-        return False
-    func = node.value.func
-    if not (isinstance(func, ast.Attribute) and func.attr == "__init__"):
-        return False
-    owner = func.value
-    if (isinstance(owner, ast.Call) and isinstance(owner.func, ast.Name)
-            and owner.func.id == "super"):
-        return True
-    # explicit-base form used by the diamond tips (Buffered* variants)
-    return isinstance(owner, (ast.Name, ast.Attribute))
-
-
-def _index_class(file: SourceFile, node: ast.ClassDef) -> ClassInfo:
-    info = ClassInfo(
-        name=node.name,
-        bases=tuple(b for b in map(_base_name, node.bases) if b),
-        file=file,
-        node=node,
-    )
-    for item in node.body:
-        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        held = file.lock_held_annotation(item.lineno)
-        if held:
-            info.lock_held[item.name] = tuple(held)
-        for stmt in ast.walk(item):
-            if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
-                continue
-            attr = _self_attr_target(stmt)
-            if attr is None:
-                continue
-            lock = file.guarded_annotation(stmt.lineno)
-            if lock is not None:
-                info.guarded.setdefault(attr, lock)
-                info.guard_decl_lines.add(stmt.lineno)
-        if item.name != "__init__":
-            continue
-        info.init_node = item
-        for stmt in item.body:
-            if _is_super_init_call(stmt):
-                if info.super_call_line is None:
-                    info.super_call_line = stmt.lineno
-                continue
-            for sub in ast.walk(stmt):
-                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
-                    continue
-                attr = _self_attr_target(sub)
-                if attr is None:
-                    continue
-                info.init_assigned.add(attr)
-                if _is_construction(sub.value):
-                    info.init_constructed.setdefault(attr, sub.lineno)
-    return info
+    @property
+    def lock_held(self) -> dict[str, tuple[str, ...]]:
+        return self.facts.lock_held
 
 
 class Project:
-    """Cross-file index: every class, with by-name ancestor resolution."""
+    """Whole-program index: classes, functions, resolved call edges."""
 
     def __init__(self):
-        self.files: list[SourceFile] = []
+        self.files: list[FileFacts] = []
+        self.root: Path | None = None
         # EVERY class definition — duplicate simple names included, so a
         # name collision (two flax modules called SqueezeExcite, say) can
         # never silently exempt the later class from the per-class rules
-        self.all_classes: list[ClassInfo] = []
+        self.all_classes: list[ClassView] = []
         # simple name -> first definition, for base resolution only
         # (deterministic because files arrive sorted)
-        self.classes: dict[str, ClassInfo] = {}
+        self.classes: dict[str, ClassView] = {}
+        self._by_path: dict[str, FileFacts] = {}
+        self._views: dict[tuple[str, int], ClassView] = {}
+        # path -> name -> module-level function index
+        self._module_funcs: dict[str, dict[str, int]] = {}
+        # path -> parent func index -> name -> first child index
+        self._named_children: dict[str, dict[int, dict[str, int]]] = {}
+        # path -> parent func index -> all child indices (subtree walks)
+        self._all_children: dict[str, dict[int, list[int]]] = {}
+        # memoized whole-program call index (rules/_concurrency.py)
+        self._call_index = None
 
-    def index(self, files: list[SourceFile]) -> None:
+    def index(self, files: list[FileFacts]) -> None:
         self.files = files
         for file in files:
-            for node in ast.walk(file.tree):
-                if isinstance(node, ast.ClassDef):
-                    info = _index_class(file, node)
-                    self.all_classes.append(info)
-                    self.classes.setdefault(node.name, info)
+            self._by_path[file.path] = file
+            for cf in file.classes:
+                view = ClassView(cf, file)
+                self.all_classes.append(view)
+                self.classes.setdefault(cf.name, view)
+                self._views[(file.path, cf.index)] = view
+            module_funcs: dict[str, int] = {}
+            named: dict[int, dict[str, int]] = {}
+            children: dict[int, list[int]] = {}
+            for ff in file.functions:
+                if ff.cls == -1 and ff.parent == -1 and ff.kind != "lambda":
+                    module_funcs.setdefault(ff.name, ff.index)
+                if ff.parent != -1:
+                    named.setdefault(ff.parent, {}).setdefault(
+                        ff.name, ff.index)
+                    children.setdefault(ff.parent, []).append(ff.index)
+            self._module_funcs[file.path] = module_funcs
+            self._named_children[file.path] = named
+            self._all_children[file.path] = children
 
-    def ancestors(self, info: ClassInfo) -> list[ClassInfo]:
+    # -- class hierarchy -----------------------------------------------------
+
+    def view_of(self, file: FileFacts, cls_index: int) -> ClassView:
+        return self._views[(file.path, cls_index)]
+
+    def ancestors(self, info: ClassView) -> list[ClassView]:
         """Transitive base classes resolvable by simple name, nearest
         first; cycles and unknown bases are skipped."""
-        out: list[ClassInfo] = []
+        out: list[ClassView] = []
         seen = {info.name}
         queue = list(info.bases)
         while queue:
@@ -316,7 +265,7 @@ class Project:
             queue.extend(base_info.bases)
         return out
 
-    def effective_guarded(self, info: ClassInfo) -> dict[str, str]:
+    def effective_guarded(self, info: ClassView) -> dict[str, str]:
         """A class's guarded-field map, own declarations first, then
         inherited ones (the subclass may re-declare under another lock)."""
         merged: dict[str, str] = {}
@@ -325,7 +274,7 @@ class Project:
                 merged.setdefault(attr, lock)
         return merged
 
-    def effective_lock_held(self, info: ClassInfo,
+    def effective_lock_held(self, info: ClassView,
                             method: str) -> tuple[str, ...]:
         """``# lock-held:`` annotation for a method, inherited along the
         base chain (an override of a lock-held method keeps the contract
@@ -335,18 +284,118 @@ class Project:
                 return ci.lock_held[method]
         return ()
 
+    # -- function index / call graph -----------------------------------------
+
+    def owner_class(self, file: FileFacts,
+                    func: FuncFact) -> ClassView | None:
+        """The class a function body belongs to lexically: the method's
+        class, also for defs/lambdas nested inside a method."""
+        f = func
+        while f.cls == -1 and f.parent != -1:
+            f = file.functions[f.parent]
+        if f.cls != -1:
+            return self.view_of(file, f.cls)
+        return None
+
+    def resolve_method(self, view: ClassView,
+                       name: str) -> tuple[FileFacts, FuncFact] | None:
+        """``self.<name>()`` resolution: own method table first, then the
+        base chain (nearest ancestor wins — static MRO approximation)."""
+        for ci in [view, *self.ancestors(view)]:
+            idx = ci.facts.methods.get(name)
+            if idx is not None:
+                return ci.file, ci.file.functions[idx]
+        return None
+
+    def resolve_ref(self, file: FileFacts, owner_func: int,
+                    ref: tuple[str, str]) -> tuple[FileFacts, FuncFact] | None:
+        """Resolve a callable reference from inside ``owner_func``.
+
+        ``("self", m)`` resolves through the lexical class's diamond;
+        ``("name", n)`` resolves nested defs in enclosing scopes (nearest
+        first), then module-level functions of the same file. Anything else
+        is unresolved — the call graph under-approximates by design."""
+        kind, name = ref
+        if kind == "self":
+            if owner_func < 0:
+                return None
+            view = self.owner_class(file, file.functions[owner_func])
+            if view is None:
+                return None
+            return self.resolve_method(view, name)
+        if kind == "name":
+            named = self._named_children.get(file.path, {})
+            cursor = owner_func
+            while cursor != -1:
+                idx = named.get(cursor, {}).get(name)
+                if idx is not None:
+                    return file, file.functions[idx]
+                cursor = file.functions[cursor].parent
+            idx = self._module_funcs.get(file.path, {}).get(name)
+            if idx is not None:
+                return file, file.functions[idx]
+        return None
+
+    def resolve_call(self, file: FileFacts,
+                     call) -> tuple[FileFacts, FuncFact] | None:
+        if call.target is None:
+            return None
+        return self.resolve_ref(file, call.func, call.target)
+
+    def subtree(self, file: FileFacts, func: FuncFact):
+        """``func`` plus every def/lambda nested inside it."""
+        children = self._all_children.get(file.path, {})
+        stack = [func.index]
+        while stack:
+            idx = stack.pop()
+            yield file.functions[idx]
+            stack.extend(children.get(idx, ()))
+
+    def thread_entries(self):
+        """Resolved thread-entry functions: ``(file, func, via, line,
+        registered_in)`` for every callable handed to a thread constructor,
+        timer, or pool dispatch anywhere in the project."""
+        out = []
+        seen: set[tuple[str, int]] = set()
+        for file in self.files:
+            for via, ref, line, owner in file.thread_entries:
+                resolved = self.resolve_ref(file, owner, ref)
+                if resolved is None:
+                    continue
+                tfile, tfunc = resolved
+                key = (tfile.path, tfunc.index)
+                if key in seen:
+                    continue
+                seen.add(key)
+                out.append((tfile, tfunc, via, line, file.path))
+        return out
+
+    # -- lock identity -------------------------------------------------------
+
+    def lock_id(self, view: ClassView | None, attr: str) -> str:
+        """Qualified lock name for ``self.<attr>``: the ROOT-most class in
+        the hierarchy whose ``__init__`` assigns the attr (so every class
+        in one diamond names the shared lock identically)."""
+        if view is None:
+            return attr
+        owner = view.name
+        for ci in [view, *self.ancestors(view)]:
+            if attr in ci.facts.init_assigned:
+                owner = ci.name  # keep searching: root-most declarer wins
+        return f"{owner}.{attr}"
+
 
 class Rule:
     """One pluggable invariant. Subclasses set ``name``/``description`` and
-    implement any of the three hooks."""
+    implement any of the three hooks (all operate on FileFacts)."""
 
     name = "rule"
     description = ""
 
-    def collect(self, file: SourceFile, project: Project) -> None:
+    def collect(self, file: FileFacts, project: Project) -> None:
         """Pass 1, per file: accumulate cross-file state on ``self``."""
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
         """Pass 2, per file: emit this file's findings."""
         return []
 
@@ -380,15 +429,30 @@ def run_analysis(
     rules: list[Rule],
     exclude: tuple[str, ...] = (),
     root: str | Path | None = None,
+    cache_dir: str | Path | None = None,
+    use_cache: bool = True,
 ) -> tuple[list[Finding], list[Waiver], list[str]]:
     """Run ``rules`` over every ``.py`` under ``paths``.
 
     Returns ``(findings, waivers, scanned)``: ALL findings (waived ones
     flagged, unjustified/unused waivers surfaced as rule ``waiver``
     findings), every waiver directive seen, and the scanned file list.
-    Paths in findings are relative to ``root`` when given."""
+    Paths in findings are relative to ``root`` when given.
+
+    With ``use_cache`` (default), per-file facts are served from the
+    ``(path, mtime, size)``-keyed sidecar under ``cache_dir`` (default
+    ``<root>/.fedlint_cache``) and re-extracted only for changed files."""
+    from fedml_tpu.analysis.cache import FactsCache
+
     root = Path(root) if root is not None else None
-    files: list[SourceFile] = []
+    cache = None
+    if use_cache:
+        if cache_dir is None and root is not None:
+            cache_dir = root / ".fedlint_cache"
+        if cache_dir is not None:
+            cache = FactsCache(cache_dir)
+
+    files: list[FileFacts] = []
     findings: list[Finding] = []
     for path in discover_files(paths, exclude):
         display = str(path)
@@ -397,14 +461,28 @@ def run_analysis(
                 display = str(path.resolve().relative_to(root.resolve()))
             except ValueError:
                 pass
-        try:
-            files.append(SourceFile(display, path.read_text()))
-        except SyntaxError as e:
-            findings.append(Finding(
-                "parse-error", display, e.lineno or 0, e.offset or 0,
-                f"unparseable module: {e.msg}",
-            ))
+        stat = path.stat()
+        facts = None
+        if cache is not None:
+            facts = cache.get(display, stat.st_mtime_ns, stat.st_size)
+        if facts is None:
+            try:
+                source = SourceFile(display, path.read_text())
+            except SyntaxError as e:
+                findings.append(Finding(
+                    "parse-error", display, e.lineno or 0, e.offset or 0,
+                    f"unparseable module: {e.msg}",
+                ))
+                continue
+            facts = extract_facts(source)
+            if cache is not None:
+                cache.put(display, stat.st_mtime_ns, stat.st_size, facts)
+        files.append(facts)
+    if cache is not None:
+        cache.save()
+
     project = Project()
+    project.root = root
     project.index(files)
     for rule in rules:
         for file in files:
@@ -416,22 +494,30 @@ def run_analysis(
 
     # waiver application: suppress (but keep) matching findings
     by_path = {f.path: f for f in files}
+    waiver_objs: dict[tuple[str, int], Waiver] = {}
+    for file in files:
+        for line, wf in file.waivers.items():
+            waiver_objs[(file.path, line)] = Waiver(
+                file.path, wf.line, wf.rules, wf.reason)
     active = {rule.name for rule in rules}
     for finding in findings:
         file = by_path.get(finding.path)
         if file is None:
             continue
-        waiver = file.waiver_for(finding.rule, finding.line)
-        if waiver is not None and waiver.reason is not None:
+        wf = file.waiver_fact_for(finding.rule, finding.line)
+        if wf is None:
+            continue
+        waiver = waiver_objs[(file.path, wf.line)]
+        if waiver.reason is not None:
             finding.waived = True
             finding.waiver_reason = waiver.reason
             waiver.used = True
-        elif waiver is not None:
+        else:
             # matched but unjustified: the finding stays live and the
             # directive is reported below
             waiver.used = True
 
-    waivers = [w for f in files for w in f.waivers.values()]
+    waivers = [waiver_objs[key] for key in sorted(waiver_objs)]
     for waiver in waivers:
         if waiver.reason is None:
             findings.append(Finding(

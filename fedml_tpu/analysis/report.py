@@ -1,8 +1,19 @@
-"""fedlint reporting: text for humans/CI logs, json for tooling.
+"""fedlint reporting: text for humans/CI logs, json for tooling, SARIF
+2.1.0 for CI annotation surfaces, and baseline diffing.
 
-Both renderers receive the FULL finding list (waived included) so every
-report enumerates the active waivers next to the live findings — a waiver
-that hides a violation silently would defeat the gate's point.
+Both full renderers receive the FULL finding list (waived included) so
+every report enumerates the active waivers next to the live findings — a
+waiver that hides a violation silently would defeat the gate's point. In
+SARIF, waived findings ride along as suppressed results (``suppressions``
+with the in-source justification), which annotation UIs hide by default
+but auditors can still enumerate.
+
+Baseline mode (``tools/fedlint.py --baseline report.json``) compares the
+current run against a previously saved ``--format json`` report and keeps
+only NEW live findings. Findings match on ``(rule, path, message)`` — not
+line numbers, which shift under unrelated edits — so CI can annotate only
+what a PR introduced. Exit-code semantics: the gate fails on new findings
+only; pre-existing baseline findings are reported as carried.
 """
 
 from __future__ import annotations
@@ -12,6 +23,12 @@ import json
 from fedml_tpu.analysis.core import Finding, Waiver
 
 REPORT_SCHEMA_VERSION = 1
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
 
 
 def live_findings(findings: list[Finding]) -> list[Finding]:
@@ -59,3 +76,103 @@ def render_json(findings: list[Finding], waivers: list[Waiver],
         },
         indent=2,
     )
+
+
+def render_sarif(findings: list[Finding], waivers: list[Waiver],
+                 scanned: list[str], rule_names: list[str],
+                 rule_descriptions: dict[str, str] | None = None) -> str:
+    """Minimal valid SARIF 2.1.0: one run, one result per finding (waived
+    findings become suppressed results with their justification)."""
+    descriptions = rule_descriptions or {}
+    # results may fire for rules outside the selection (parse-error, waiver)
+    rule_ids = sorted({*rule_names, *(f.rule for f in findings)})
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": max(1, f.line),
+                        "startColumn": max(1, f.col + 1),
+                    },
+                },
+            }],
+        }
+        if f.waived:
+            result["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.waiver_reason or "",
+            }]
+        results.append(result)
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "fedlint",
+                    "informationUri": "docs/STATIC_ANALYSIS.md",
+                    "rules": [
+                        {
+                            "id": rid,
+                            "shortDescription": {
+                                "text": descriptions.get(rid, rid),
+                            },
+                        }
+                        for rid in rule_ids
+                    ],
+                },
+            },
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
+
+
+RENDERERS = {
+    "text": render_text,
+    "json": render_json,
+    "sarif": render_sarif,
+}
+
+
+def finding_key(finding: Finding | dict) -> tuple[str, str, str]:
+    """Baseline identity: (rule, path, message). Line/col shift under
+    unrelated edits, the message text pins the actual defect."""
+    if isinstance(finding, dict):
+        return (finding["rule"], finding["path"], finding["message"])
+    return (finding.rule, finding.path, finding.message)
+
+
+def load_baseline(path: str) -> set[tuple[str, str, str]]:
+    """LIVE finding keys of a previously saved ``--format json`` report.
+
+    Raises ``ValueError`` on a file that is not a fedlint JSON report — a
+    malformed baseline must fail the gate loudly, not silently match
+    nothing and annotate every finding as new."""
+    from pathlib import Path
+
+    try:
+        doc = json.loads(Path(path).read_text())
+        findings = doc["findings"]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        raise ValueError(
+            f"baseline {path!r} is not a fedlint --format json report: {e}"
+        ) from e
+    return {finding_key(f) for f in findings if not f.get("waived")}
+
+
+def split_by_baseline(
+    findings: list[Finding], baseline: set[tuple[str, str, str]],
+) -> tuple[list[Finding], list[Finding]]:
+    """(new, carried) LIVE findings relative to a baseline key set; waived
+    findings are never diffed (they are enumerable in the full report)."""
+    new: list[Finding] = []
+    carried: list[Finding] = []
+    for f in live_findings(findings):
+        (carried if finding_key(f) in baseline else new).append(f)
+    return new, carried

@@ -9,9 +9,12 @@ from __future__ import annotations
 
 from fedml_tpu.analysis.config import FedlintConfig
 from fedml_tpu.analysis.core import Rule
+from fedml_tpu.analysis.rules.blocking_under_lock import BlockingUnderLockRule
 from fedml_tpu.analysis.rules.guarded_by import GuardedByRule
+from fedml_tpu.analysis.rules.lock_order import LockOrderRule
 from fedml_tpu.analysis.rules.metric_keys import MetricKeysRule
 from fedml_tpu.analysis.rules.overwrite_after_super import OverwriteAfterSuperRule
+from fedml_tpu.analysis.rules.thread_entry import ThreadEntryRule
 from fedml_tpu.analysis.rules.traced_purity import TracedPurityRule
 from fedml_tpu.analysis.rules.wire_contract import WireContractRule
 
@@ -23,6 +26,9 @@ _REGISTRY = {
         WireContractRule,
         TracedPurityRule,
         MetricKeysRule,
+        LockOrderRule,
+        BlockingUnderLockRule,
+        ThreadEntryRule,
     )
 }
 

@@ -1,0 +1,115 @@
+"""Shared machinery for the interprocedural concurrency rules.
+
+Lock identity: a ``with self.<attr>:`` site names a lock by attribute; the
+rules qualify it to ``<RootDeclaringClass>.<attr>`` via
+:meth:`~fedml_tpu.analysis.core.Project.lock_id` so every class in one
+diamond names the shared lock identically, and two unrelated classes that
+both call their lock ``_lock`` stay distinct nodes in the acquisition
+graph. ``[tool.fedlint] lock-aliases`` (``"<from>=<to>"`` entries) merges
+spellings that alias ONE runtime lock: a bare ``attr=attr2`` entry renames
+the attribute before qualification, a qualified ``Class.attr=Class2.attr2``
+entry rewrites the final id.
+
+Annotation semantics: ``# lock-held: <lock>`` on a method is a CLAIM that
+every caller holds the lock — the intraprocedural rules treat it as held,
+and the thread-entry rule is the one that checks the claim against real
+call paths.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from fedml_tpu.analysis.core import Project
+from fedml_tpu.analysis.facts import CallFact, FileFacts, FuncFact
+
+
+class LockNames:
+    """Qualified, alias-canonical lock naming for one rule run."""
+
+    def __init__(self, aliases: tuple[str, ...] = ()):
+        self.bare: dict[str, str] = {}
+        self.full: dict[str, str] = {}
+        for entry in aliases:
+            src, sep, dst = entry.partition("=")
+            src, dst = src.strip(), dst.strip()
+            if not sep or not src or not dst:
+                raise ValueError(
+                    f"lock-aliases entry {entry!r}: expected '<from>=<to>'"
+                )
+            if "." in src:
+                self.full[src] = dst
+            else:
+                self.bare[src] = dst
+
+    def qualify(self, project: Project, view, attr: str) -> str:
+        """Canonical lock id for ``self.<attr>`` in the given class."""
+        attr = self.bare.get(attr, attr)
+        if "." in attr:  # bare alias mapped straight to a qualified id
+            return self.full.get(attr, attr)
+        lid = project.lock_id(view, attr)
+        return self.full.get(lid, lid)
+
+    def qualify_all(self, project: Project, view,
+                    attrs) -> frozenset[str]:
+        return frozenset(self.qualify(project, view, a) for a in attrs)
+
+
+def annotation_locks(project: Project, names: LockNames, file: FileFacts,
+                     func: FuncFact) -> frozenset[str]:
+    """Qualified ``# lock-held:`` locks for a function: methods inherit the
+    annotation along the base chain (an un-annotated override keeps the
+    contract), nested defs/lambdas carry only their own annotation."""
+    view = project.owner_class(file, func)
+    if func.cls != -1 and view is not None:
+        attrs = project.effective_lock_held(view, func.name)
+    else:
+        attrs = func.lock_held
+    if not attrs:
+        return frozenset()
+    return names.qualify_all(project, view, attrs)
+
+
+def site(file: FileFacts, func: FuncFact, line: int) -> str:
+    return f"{func.qualname} ({file.path}:{line})"
+
+
+def func_key(file: FileFacts, func: FuncFact) -> tuple[str, int]:
+    return (file.path, func.index)
+
+
+@dataclasses.dataclass
+class CallIndex:
+    """Whole-program function table + resolved call edges, built ONCE per
+    rule run — the shared scaffolding of all three concurrency rules.
+
+    ``funcs``: function key -> (file, func). ``resolved``: function key ->
+    ``(call_fact, callee_key)`` rows for every call the project can
+    resolve (unresolvable calls are dropped here — the rules never see
+    them, which is the documented under-approximation)."""
+
+    funcs: dict[tuple[str, int], tuple[FileFacts, FuncFact]]
+    resolved: dict[tuple[str, int], list[tuple[CallFact, tuple[str, int]]]]
+
+
+def build_call_index(project: Project) -> CallIndex:
+    """Memoized per Project: all three concurrency rules share one index
+    (it depends only on the project, and projects are per-run)."""
+    cached = getattr(project, "_call_index", None)
+    if cached is not None:
+        return cached
+    funcs: dict[tuple[str, int], tuple[FileFacts, FuncFact]] = {}
+    resolved: dict[tuple[str, int], list] = {}
+    for file in project.files:
+        for func in file.functions:
+            fk = func_key(file, func)
+            funcs[fk] = (file, func)
+            rows = []
+            for call_idx in func.calls:
+                call = file.calls[call_idx]
+                callee = project.resolve_call(file, call)
+                if callee is not None:
+                    rows.append((call, func_key(*callee)))
+            resolved[fk] = rows
+    project._call_index = CallIndex(funcs, resolved)
+    return project._call_index

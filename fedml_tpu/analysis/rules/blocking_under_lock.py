@@ -1,0 +1,174 @@
+"""blocking-under-lock: no blocking call while any lock is held.
+
+Provenance: two hard-won disciplines this repo already enforces by prose
+and review. PR 8: "the server snapshot is taken under the round lock but
+WRITTEN outside it — full-model disk I/O never blocks the upload/heartbeat
+handlers". PR 11: "trace events emitted after release"; and the tree
+re-broadcast fix — "_on_sync_from_parent snapshots round under _edge_lock
+and re-broadcasts outside it" (a lock held across a fan-out serializes
+every child behind one receiver's timeout). The rule machine-checks them:
+
+- a call matching a configured blocking pattern (``blocking-calls``:
+  file/npz writes, ``send_message``/``broadcast_message``, ``time.sleep``,
+  ``queue.join``, ``.result()``, ``.wait()``) is a finding when ANY lock
+  is held at the call site — syntactically (``with self.<lock>:``) or by
+  ``# lock-held:`` contract;
+- interprocedurally: a call made while holding a lock that RESOLVES to a
+  function which transitively reaches a blocking call is the same finding,
+  naming the chain — this is the edge v1's one-function-at-a-time view
+  could not see.
+
+Exemption: ``<lock>.wait()`` on the very lock that is held is the
+Condition pattern — ``Condition.wait`` releases the lock while waiting —
+so it only fires when OTHER locks are also held across the wait.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.rules._concurrency import (
+    LockNames,
+    annotation_locks,
+    build_call_index,
+)
+
+
+class BlockingUnderLockRule(Rule):
+    name = "blocking-under-lock"
+    description = ("no configured blocking call (I/O, sends, sleeps, "
+                   "joins, futures) while any lock is held along the "
+                   "resolved call chain — snapshot under the lock, do the "
+                   "slow work outside")
+
+    def __init__(self, config):
+        self.config = config
+        self.patterns = tuple(getattr(config, "blocking_calls", ()))
+        self.names = LockNames(getattr(config, "lock_aliases", ()))
+
+    def _blocking_pattern(self, dotted: str | None) -> str | None:
+        if dotted is None:
+            return None
+        for pattern in self.patterns:
+            if fnmatch.fnmatchcase(dotted, pattern):
+                return pattern
+        return None
+
+    @staticmethod
+    def _wait_receiver(dotted: str) -> str | None:
+        """`self._cv.wait` -> `_cv` (the Condition exemption)."""
+        parts = dotted.split(".")
+        if len(parts) >= 2 and parts[-1] == "wait":
+            return parts[-2]
+        return None
+
+    def finalize(self, project: Project) -> list[Finding]:
+        names = self.names
+        findings: list[Finding] = []
+        index = build_call_index(project)
+
+        # nearest transitively-reachable blocking call per function:
+        # fk -> (chain description, dotted, pattern, wait_lock). wait_lock
+        # is the qualified lock a `.wait()` leaf waits ON (None otherwise):
+        # callers holding ONLY that lock are exempt — Condition.wait
+        # releases it — however deep the wait sits in the chain.
+        reach: dict[tuple, tuple[str, str, str, str | None] | None] = {}
+        for fk, (file, func) in index.funcs.items():
+            direct = None
+            for call_idx in func.calls:
+                call = file.calls[call_idx]
+                pattern = self._blocking_pattern(call.dotted)
+                if pattern is None:
+                    continue
+                recv = self._wait_receiver(call.dotted)
+                if recv is None:
+                    # a non-wait leaf is the strongest witness (no lock
+                    # exempts it): it must never be masked by an earlier
+                    # wait leaf whose wait_lock a caller happens to hold
+                    direct = (
+                        f"{func.qualname} ({file.path}:{call.line})",
+                        call.dotted, pattern, None,
+                    )
+                    break
+                if direct is None:
+                    direct = (
+                        f"{func.qualname} ({file.path}:{call.line})",
+                        call.dotted, pattern,
+                        names.qualify(
+                            project, project.owner_class(file, func), recv),
+                    )
+            reach[fk] = direct
+        changed = True
+        while changed:
+            changed = False
+            for fk, resolved in index.resolved.items():
+                mine = reach[fk]
+                if mine is not None and mine[3] is None:
+                    continue  # already holds an unexemptable witness
+                file, func = index.funcs[fk]
+                for call, callee_fk in resolved:
+                    sub = reach.get(callee_fk)
+                    if sub is None:
+                        continue
+                    if mine is not None and sub[3] is not None:
+                        continue  # never downgrade / sideways-swap waits
+                    # adopt: first witness found, or upgrade a wait-witness
+                    # to a non-wait one (a savez behind one callee must not
+                    # be masked by a Condition-wait behind another)
+                    reach[fk] = mine = (
+                        f"{func.qualname} ({file.path}:{call.line}) "
+                        f"-> {sub[0]}",
+                        sub[1], sub[2], sub[3],
+                    )
+                    changed = True
+                    if mine[3] is None:
+                        break
+
+        for fk in sorted(index.funcs):
+            file, func = index.funcs[fk]
+            view = project.owner_class(file, func)
+            held0 = annotation_locks(project, names, file, func)
+            resolved_at = {id(call): callee_fk
+                           for call, callee_fk in index.resolved[fk]}
+            for call_idx in func.calls:
+                call = file.calls[call_idx]
+                held = names.qualify_all(project, view, call.held) | held0
+                if not held:
+                    continue
+                pattern = self._blocking_pattern(call.dotted)
+                if pattern is not None:
+                    recv = self._wait_receiver(call.dotted)
+                    if recv is not None:
+                        held = held - {names.qualify(project, view, recv)}
+                        if not held:
+                            continue  # Condition.wait releases the lock
+                    findings.append(Finding(
+                        self.name, file.path, call.line, call.col,
+                        f"blocking call {call.dotted}() (matches "
+                        f"{pattern!r}) while holding "
+                        f"{', '.join(sorted(held))} — blocking inside a "
+                        "critical section stalls every thread contending "
+                        "for the lock; snapshot under the lock and do the "
+                        "slow work after release",
+                    ))
+                    continue
+                callee_fk = resolved_at.get(id(call))
+                if callee_fk is None:
+                    continue
+                sub = reach.get(callee_fk)
+                if sub is None:
+                    continue
+                chain, dotted, pattern, wait_lock = sub
+                effective = held - {wait_lock} if wait_lock else held
+                if not effective:
+                    continue  # only the waited-on Condition is held
+                findings.append(Finding(
+                    self.name, file.path, call.line, call.col,
+                    f"call chain from `{func.qualname}` while holding "
+                    f"{', '.join(sorted(effective))} reaches blocking "
+                    f"{dotted}() (matches {pattern!r}): {chain} — "
+                    "the lock stays held across the whole chain; "
+                    "move the call outside the critical section",
+                ))
+        return findings

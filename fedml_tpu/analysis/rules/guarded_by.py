@@ -17,91 +17,14 @@ machine-checked:
 - ``__init__`` and the declaration lines themselves are exempt (the object
   is not shared during construction), as are deferred closures' bodies —
   no: closures are checked with NO locks held, because they run later, on
-  whatever thread calls them.
+  whatever thread calls them (facts extraction resets the held set at
+  every nested def/lambda boundary; see facts.py).
 """
 
 from __future__ import annotations
 
-import ast
-
-from fedml_tpu.analysis.core import ClassInfo, Finding, Project, Rule, SourceFile
-
-
-def _with_locks(node: ast.With) -> set[str]:
-    """Lock names acquired by ``with self.<name>[, ...]:`` items."""
-    out: set[str] = set()
-    for item in node.items:
-        expr = item.context_expr
-        if (isinstance(expr, ast.Attribute)
-                and isinstance(expr.value, ast.Name)
-                and expr.value.id == "self"):
-            out.add(expr.attr)
-    return out
-
-
-class _MethodWalk(ast.NodeVisitor):
-    def __init__(self, rule: str, file: SourceFile, info: ClassInfo,
-                 guarded: dict[str, str], held: set[str],
-                 ancestors: list[ClassInfo]):
-        self.rule = rule
-        self.file = file
-        self.info = info
-        self.guarded = guarded
-        self.held = held
-        self.ancestors = ancestors
-        self.findings: list[Finding] = []
-
-    def visit_With(self, node: ast.With) -> None:
-        added = _with_locks(node) - self.held
-        for item in node.items:
-            self.visit(item.context_expr)
-        self.held |= added
-        for stmt in node.body:
-            self.visit(stmt)
-        self.held -= added
-
-    visit_AsyncWith = visit_With
-
-    def _deferred(self, node: ast.AST) -> None:
-        # a nested def/lambda runs later on an arbitrary thread: whatever
-        # locks the enclosing method holds will NOT be held then
-        inner = _MethodWalk(self.rule, self.file, self.info, self.guarded,
-                            set(), self.ancestors)
-        for child in ast.iter_child_nodes(node):
-            inner.visit(child)
-        self.findings.extend(inner.findings)
-
-    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._deferred(node)
-
-    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._deferred(node)
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        self._deferred(node)
-
-    def visit_Attribute(self, node: ast.Attribute) -> None:
-        if (isinstance(node.value, ast.Name) and node.value.id == "self"
-                and node.attr in self.guarded
-                and node.lineno not in self.info.guard_decl_lines):
-            lock = self.guarded[node.attr]
-            if lock not in self.held:
-                self.findings.append(Finding(
-                    "guarded-by", self.file.path, node.lineno,
-                    node.col_offset,
-                    f"self.{node.attr} is guarded by self.{lock} "
-                    f"(declared in {self._decl_site(node.attr)}) but is "
-                    "touched without it — wrap in `with self."
-                    f"{lock}:` or annotate the method `# lock-held: {lock}`",
-                ))
-        self.generic_visit(node)
-
-    def _decl_site(self, attr: str) -> str:
-        # nearest declaring class in the chain, for the message only
-        for info in [self.info, *self.ancestors]:
-            if attr in info.guarded:
-                return info.name
-        return self.info.name
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.facts import FileFacts
 
 
 class GuardedByRule(Rule):
@@ -113,25 +36,50 @@ class GuardedByRule(Rule):
     def __init__(self, config):
         self.config = config
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
         findings: list[Finding] = []
-        for info in project.all_classes:
-            if info.file is not file:
-                continue
-            guarded = project.effective_guarded(info)
+        for cf in file.classes:
+            view = project.view_of(file, cf.index)
+            guarded = project.effective_guarded(view)
             if not guarded:
                 continue
-            ancestors = project.ancestors(info)
-            for item in info.node.body:
-                if not isinstance(item, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef)):
+            ancestors = project.ancestors(view)
+            # every DIRECT method def (duplicate names included — property
+            # setter pairs must both be checked), not the name table
+            for method in file.functions:
+                if method.cls != cf.index:
                     continue
-                if item.name == "__init__":
+                if method.name == "__init__":
                     continue  # construction: the object is not shared yet
-                held = set(project.effective_lock_held(info, item.name))
-                walk = _MethodWalk(self.name, file, info, guarded, held,
-                                   ancestors)
-                for stmt in item.body:
-                    walk.visit(stmt)
-                findings.extend(walk.findings)
+                held0 = set(project.effective_lock_held(view, method.name))
+                for func in project.subtree(file, method):
+                    # nested defs/lambdas run later, on arbitrary threads:
+                    # neither the method's annotation nor its with-blocks
+                    # protect them (their own with-blocks still count)
+                    base_held = held0 if func.index == method.index else set()
+                    for attr, line, col, held in func.touches:
+                        if attr not in guarded:
+                            continue
+                        if line in cf.guard_decl_lines:
+                            continue
+                        lock = guarded[attr]
+                        if lock in held or lock in base_held:
+                            continue
+                        findings.append(Finding(
+                            self.name, file.path, line, col,
+                            f"self.{attr} is guarded by self.{lock} "
+                            f"(declared in "
+                            f"{self._decl_site(view, ancestors, attr)}) "
+                            "but is touched without it — wrap in `with self."
+                            f"{lock}:` or annotate the method "
+                            f"`# lock-held: {lock}`",
+                        ))
         return findings
+
+    @staticmethod
+    def _decl_site(view, ancestors, attr: str) -> str:
+        # nearest declaring class in the chain, for the message only
+        for info in [view, *ancestors]:
+            if attr in info.guarded:
+                return info.name
+        return view.name

@@ -1,0 +1,183 @@
+"""lock-order: deadlock-shaped cycles in the lock-acquisition graph.
+
+Provenance: the PR 10 tier-1 deadlock (concurrently dispatched in-silo
+executables wedging each other) and the PR 11 review pass, which found
+seven real lock bugs BY HAND across the tree/async/faults managers — every
+one a variant of "lock B taken while holding lock A in one thread, A while
+holding B in another". The rule builds the whole-program acquisition
+graph:
+
+- a ``with self.B:`` lexically inside ``with self.A:`` adds edge A -> B;
+- a method annotated ``# lock-held: A`` that acquires B adds A -> B (the
+  caller holds A by contract);
+- a call made while holding A, resolving (self-methods through the class
+  diamond, bare names to nested/module functions) to a function that
+  TRANSITIVELY acquires B, adds A -> B — the interprocedural edge v1 could
+  not see.
+
+Lock identity is the root-most declaring class (core.Project.lock_id), so
+one diamond's shared lock is one node while unrelated ``_lock`` attrs stay
+distinct; ``lock-aliases`` merges spellings of one runtime lock. Findings:
+
+- any CYCLE in the graph names the full path (A -> B -> A) with one
+  example acquisition site per edge — two threads walking the cycle from
+  different entry points deadlock;
+- acquiring a lock ALREADY HELD along the chain (directly or through
+  calls) is a self-deadlock: ``threading.Lock`` is not reentrant.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.rules._concurrency import (
+    LockNames,
+    annotation_locks,
+    build_call_index,
+)
+
+
+class LockOrderRule(Rule):
+    name = "lock-order"
+    description = ("no cycles in the whole-program lock-acquisition order "
+                   "(with-blocks, # lock-held: contracts, and resolved "
+                   "call chains); no re-acquisition of a held lock")
+
+    def __init__(self, config):
+        self.config = config
+        self.names = LockNames(getattr(config, "lock_aliases", ()))
+
+    def finalize(self, project: Project) -> list[Finding]:
+        names = self.names
+        findings: list[Finding] = []
+
+        index = build_call_index(project)
+
+        # per-function: qualified direct acquisitions + annotation set
+        acquires: dict[tuple, list[tuple[str, int, frozenset[str]]]] = {}
+        ann: dict[tuple, frozenset[str]] = {}
+        for fk, (file, func) in index.funcs.items():
+            view = project.owner_class(file, func)
+            ann[fk] = annotation_locks(project, names, file, func)
+            acquires[fk] = [
+                (names.qualify(project, view, lock), line,
+                 names.qualify_all(project, view, held))
+                for lock, line, held in func.acquires
+            ]
+
+        # transitive acquisition sets with one witness site per lock
+        trans: dict[tuple, dict[str, str]] = {
+            fk: {
+                lock: f"{index.funcs[fk][1].qualname} ({fk[0]}:{line})"
+                for lock, line, _held in sorted(acq, key=lambda t: t[1])
+            }
+            for fk, acq in acquires.items()
+        }
+        changed = True
+        while changed:
+            changed = False
+            for fk, resolved in index.resolved.items():
+                mine = trans[fk]
+                for call, callee_fk in resolved:
+                    for lock, wit in trans.get(callee_fk, {}).items():
+                        if lock not in mine:
+                            mine[lock] = wit
+                            changed = True
+
+        # edges + self-deadlocks: (from, to) -> (desc, path, line)
+        edges: dict[tuple[str, str], tuple[str, str, int]] = {}
+        for fk in sorted(index.funcs):
+            file, func = index.funcs[fk]
+            held0 = ann[fk]
+            for lock, line, held_before in acquires[fk]:
+                held_all = held_before | held0
+                for h in sorted(held_all):
+                    if h == lock:
+                        findings.append(Finding(
+                            self.name, file.path, line, 0,
+                            f"{lock} acquired in `{func.qualname}` while "
+                            "already held along this chain — "
+                            "threading.Lock is not reentrant; this "
+                            "deadlocks the thread against itself",
+                        ))
+                    else:
+                        edges.setdefault((h, lock), (
+                            f"{func.qualname} ({file.path}:{line})",
+                            file.path, line,
+                        ))
+            for call, callee_fk in index.resolved[fk]:
+                view = project.owner_class(file, func)
+                held_at = names.qualify_all(project, view, call.held) | held0
+                if not held_at:
+                    continue
+                for lock, wit in trans.get(callee_fk, {}).items():
+                    if lock in held_at:
+                        findings.append(Finding(
+                            self.name, file.path, call.line, call.col,
+                            f"call from `{func.qualname}` while holding "
+                            f"{lock} reaches its re-acquisition at {wit} — "
+                            "threading.Lock is not reentrant; this "
+                            "deadlocks the thread against itself",
+                        ))
+                    else:
+                        for h in sorted(held_at):
+                            edges.setdefault((h, lock), (
+                                f"{func.qualname} "
+                                f"({file.path}:{call.line}) -> {wit}",
+                                file.path, call.line,
+                            ))
+
+        findings.extend(self._cycle_findings(edges))
+        return findings
+
+    def _cycle_findings(
+            self, edges: dict[tuple[str, str], tuple[str, str, int]],
+    ) -> list[Finding]:
+        """One finding per distinct cycle, naming the full lock path and an
+        example acquisition site per edge."""
+        graph: dict[str, list[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, []).append(b)
+        for a in graph:
+            graph[a].sort()
+
+        findings: list[Finding] = []
+        seen_cycles: set[tuple[str, ...]] = set()
+        for start in sorted(graph):
+            cycle = self._find_cycle(graph, start)
+            if cycle is None:
+                continue
+            # canonical rotation: start at the smallest lock name
+            pivot = cycle.index(min(cycle))
+            canon = tuple(cycle[pivot:] + cycle[:pivot])
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            path = [*canon, canon[0]]
+            steps = []
+            for a, b in zip(path, path[1:]):
+                steps.append(f"{a} -> {b} at {edges[(a, b)][0]}")
+            _desc, loc_path, loc_line = edges[(path[0], path[1])]
+            findings.append(Finding(
+                self.name, loc_path, loc_line, 0,
+                "lock-order cycle " + " -> ".join(path) + " — two threads "
+                "acquiring these locks from different ends deadlock; "
+                "acquisition sites: " + "; ".join(steps),
+            ))
+        return findings
+
+    @staticmethod
+    def _find_cycle(graph: dict[str, list[str]],
+                    start: str) -> list[str] | None:
+        """Shortest cycle through ``start`` (BFS back to itself)."""
+        queue: list[list[str]] = [[start]]
+        visited = {start}
+        while queue:
+            path = queue.pop(0)
+            for nxt in graph.get(path[-1], ()):
+                if nxt == start:
+                    return path
+                if nxt not in visited:
+                    visited.add(nxt)
+                    queue.append(path + [nxt])
+        return None
+

@@ -1,4 +1,5 @@
-"""metric-keys: canonical Comm/ Robust/ Async/ Fleet/ record keys only.
+"""metric-keys: canonical Comm/ Robust/ Async/ Fleet/ record keys only —
+and no DEAD keys in the canonical namespace.
 
 Provenance: ``obs/metrics.py`` is the single home of the canonical metric
 namespace ("Canonical bytes-on-wire metric keys", PR 1/6/9) — the sim
@@ -9,6 +10,15 @@ nothing joins it, and the dashboard reads zero. Any string literal under a
 canonical prefix outside the defining module(s) is a finding — spell it
 ``metricslib.<CONSTANT>``.
 
+Dead-metric check (the other direction of the same rot): a constant
+DEFINED under a canonical prefix in the defining module must be (a)
+referenced by some emitting module — a key nobody emits is dead namespace
+surface — and (b) consumed somewhere: referenced by a configured reader
+tool (``metric-reader-modules``) or named in a docs table
+(``metric-doc-paths``). A key that is emitted but never read anywhere is
+exactly the silent metric rot this rule exists to kill: records land,
+nothing joins them, nobody notices.
+
 Literals containing whitespace are ignored: prose in docstrings may
 mention a key family ("the Async/* totals") without naming a record key —
 record keys never contain spaces.
@@ -16,38 +26,104 @@ record keys never contain spaces.
 
 from __future__ import annotations
 
-import ast
+from pathlib import Path
 
-from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.facts import FileFacts
 
 
 class MetricKeysRule(Rule):
     name = "metric-keys"
     description = ("Comm/ Robust/ Async/ Fleet/ record keys must come from "
-                   "the obs.metrics constants, not ad-hoc literals")
+                   "the obs.metrics constants, not ad-hoc literals; defined "
+                   "keys must be emitted somewhere and read by a report "
+                   "tool or docs table (no silent metric rot)")
 
     def __init__(self, config):
         self.config = config
         self.prefixes = tuple(config.metric_prefixes)
         self.modules = {m.replace("\\", "/") for m in config.metric_modules}
+        self.reader_modules = {
+            m.replace("\\", "/")
+            for m in getattr(config, "metric_reader_modules", ())
+        }
+        self.doc_paths = tuple(getattr(config, "metric_doc_paths", ()))
+        # defining module: NAME -> (value, path, line, col)
+        self.defs: dict[str, tuple[str, str, int, int]] = {}
+        # NAMEs referenced outside the defining/reader modules (emitters)
+        self.emitted: set[str] = set()
+        # NAMEs referenced by reader modules
+        self.read_by_tools: set[str] = set()
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
-        path = file.path.replace("\\", "/")
-        if any(path.endswith(module) for module in self.modules):
+    def _is_metric_module(self, path: str) -> bool:
+        path = path.replace("\\", "/")
+        return any(path.endswith(m) for m in self.modules)
+
+    def _is_reader_module(self, path: str) -> bool:
+        path = path.replace("\\", "/")
+        return any(path.endswith(m) for m in self.reader_modules)
+
+    def collect(self, file: FileFacts, project: Project) -> None:
+        if self._is_metric_module(file.path):
+            for name, value, line, col in file.module_consts:
+                if value.startswith(self.prefixes):
+                    self.defs.setdefault(name, (value, file.path, line, col))
+        elif self._is_reader_module(file.path):
+            self.read_by_tools |= file.upper_refs
+        else:
+            self.emitted |= file.upper_refs
+
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
+        if self._is_metric_module(file.path):
             return []
         findings: list[Finding] = []
-        for node in ast.walk(file.tree):
-            if not (isinstance(node, ast.Constant)
-                    and isinstance(node.value, str)):
-                continue
-            value = node.value
-            if any(ch.isspace() for ch in value):
-                continue
+        for value, line, col in file.str_consts:
             if value.startswith(self.prefixes):
                 findings.append(Finding(
-                    self.name, file.path, node.lineno, node.col_offset,
+                    self.name, file.path, line, col,
                     f"ad-hoc metric key literal {value!r} — import the "
                     "constant from fedml_tpu.obs.metrics (records join by "
                     "these strings; a fork reads as zero downstream)",
                 ))
         return findings
+
+    def finalize(self, project: Project) -> list[Finding]:
+        if not self.defs:
+            return []
+        docs_text = self._docs_text(project)
+        findings: list[Finding] = []
+        for name, (value, path, line, col) in sorted(self.defs.items()):
+            if name not in self.emitted:
+                findings.append(Finding(
+                    self.name, path, line, col,
+                    f"metric key {name} ({value!r}) is defined but never "
+                    "emitted — no scanned module references the constant; "
+                    "dead namespace surface (delete it or emit it)",
+                ))
+                continue
+            if name not in self.read_by_tools and value not in docs_text:
+                findings.append(Finding(
+                    self.name, path, line, col,
+                    f"metric key {name} ({value!r}) is emitted but never "
+                    "read — no report tool references it and no docs table "
+                    "names it; records land and nothing joins them "
+                    "(silent metric rot)",
+                ))
+        return findings
+
+    def _docs_text(self, project: Project) -> str:
+        """Concatenated text of the configured docs paths (markdown tables
+        count as readers — dashboards are built from them)."""
+        chunks: list[str] = []
+        root = project.root or Path(".")
+        for rel in self.doc_paths:
+            p = Path(rel)
+            if not p.is_absolute():
+                p = Path(root) / rel
+            candidates = sorted(p.rglob("*.md")) if p.is_dir() else [p]
+            for doc in candidates:
+                try:
+                    chunks.append(doc.read_text())
+                except OSError:
+                    continue
+        return "\n".join(chunks)

@@ -13,9 +13,8 @@ config hoisted ABOVE ``super().__init__``.
 
 from __future__ import annotations
 
-import ast
-
-from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile, _self_attr_target
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.facts import FileFacts
 
 
 class OverwriteAfterSuperRule(Rule):
@@ -26,35 +25,30 @@ class OverwriteAfterSuperRule(Rule):
     def __init__(self, config):
         self.config = config
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
         findings: list[Finding] = []
-        for info in project.all_classes:
-            if info.file is not file or info.init_node is None:
+        for cf in file.classes:
+            if cf.super_call_line is None:
                 continue
-            if info.super_call_line is None:
-                continue
+            view = project.view_of(file, cf.index)
             constructed: dict[str, tuple[str, int]] = {}
-            for ancestor in project.ancestors(info):
-                for attr, line in ancestor.init_constructed.items():
+            for ancestor in project.ancestors(view):
+                for attr, line in ancestor.facts.init_constructed.items():
                     constructed.setdefault(attr, (ancestor.name, line))
             if not constructed:
                 continue
-            for stmt in info.init_node.body:
-                if stmt.lineno <= info.super_call_line:
+            for attr, line, col, stmt_line in cf.init_assigns:
+                if stmt_line <= cf.super_call_line:
                     continue
-                for sub in ast.walk(stmt):
-                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
-                        continue
-                    attr = _self_attr_target(sub)
-                    if attr is None or attr not in constructed:
-                        continue
-                    base, base_line = constructed[attr]
-                    findings.append(Finding(
-                        self.name, file.path, sub.lineno, sub.col_offset,
-                        f"self.{attr} reassigned after super().__init__, "
-                        f"but {base}.__init__ (line {base_line}) already "
-                        "constructs it — construct-then-overwrite; hoist "
-                        "the config above super().__init__ and build once "
-                        "through a factory method",
-                    ))
+                if attr not in constructed:
+                    continue
+                base, base_line = constructed[attr]
+                findings.append(Finding(
+                    self.name, file.path, line, col,
+                    f"self.{attr} reassigned after super().__init__, "
+                    f"but {base}.__init__ (line {base_line}) already "
+                    "constructs it — construct-then-overwrite; hoist "
+                    "the config above super().__init__ and build once "
+                    "through a factory method",
+                ))
         return findings

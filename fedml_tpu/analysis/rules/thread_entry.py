@@ -1,0 +1,93 @@
+"""thread-entry: thread/timer callbacks must not assume caller-held locks.
+
+Provenance: the guarded-by rule's nested-def discipline ("closures are
+checked with NO locks held, because they run later, on whatever thread
+calls them") generalized interprocedurally. The wire-path runtime hands
+named functions — not just closures — to ``threading.Thread`` (heartbeat
+loops, client run loops, send-pool workers), ``threading.Timer`` (round
+closes, share timeouts, delayed fault delivery), and pool dispatch
+(``run_all``/``submit``). Those entries START WITH NO LOCKS HELD, so:
+
+- a function reachable from a thread entry that is annotated
+  ``# lock-held: <lock>`` — i.e. CLAIMS every caller holds the lock — is a
+  finding unless every path from the entry actually acquires the lock
+  before the call (``with self.<lock>:`` around the call site, at any
+  depth along the chain). The annotation would be a lie on that path, and
+  every guarded-field touch the annotation blesses is a race.
+
+The rule walks the resolved call graph from each entry, tracking the locks
+actually acquired along the path; it never guesses unresolvable calls
+(dynamic dispatch, bound methods of other objects), so it UNDER-reports
+rather than false-positives — see docs/STATIC_ANALYSIS.md for the limits.
+"""
+
+from __future__ import annotations
+
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.rules._concurrency import (
+    LockNames,
+    annotation_locks,
+    build_call_index,
+    func_key,
+)
+
+
+class ThreadEntryRule(Rule):
+    name = "thread-entry"
+    description = ("functions reachable from thread/timer/pool entry "
+                   "points must not assume caller-held locks "
+                   "(# lock-held:) unless the path actually acquires them")
+
+    def __init__(self, config):
+        self.config = config
+        self.names = LockNames(getattr(config, "lock_aliases", ()))
+
+    def finalize(self, project: Project) -> list[Finding]:
+        names = self.names
+        findings: list[Finding] = []
+        reported: set[tuple[str, int, frozenset[str]]] = set()
+        index = build_call_index(project)
+
+        entries = sorted(
+            project.thread_entries(),
+            key=lambda e: (e[4], e[3], e[0].path, e[1].index),
+        )
+        for entry_file, entry_func, via, reg_line, reg_path in entries:
+            entry_desc = (
+                f"{via} entry `{entry_func.qualname}` "
+                f"(registered at {reg_path}:{reg_line})"
+            )
+            # DFS over the resolved call graph, tracking locks actually
+            # acquired along the path
+            stack = [(entry_file, entry_func, frozenset())]
+            visited: set[tuple[str, int, frozenset[str]]] = set()
+            while stack:
+                file, func, held = stack.pop()
+                state = (file.path, func.index, held)
+                if state in visited:
+                    continue
+                visited.add(state)
+                ann = annotation_locks(project, names, file, func)
+                missing = ann - held
+                report_key = (file.path, func.index, missing)
+                if missing and report_key not in reported:
+                    reported.add(report_key)
+                    findings.append(Finding(
+                        self.name, file.path, func.line, func.col,
+                        f"`{func.qualname}` assumes caller-held "
+                        f"{', '.join(sorted(missing))} (# lock-held:) but "
+                        f"is reachable from the {entry_desc} without "
+                        "acquiring it — thread entries start with no locks "
+                        "held, so every guarded field the annotation "
+                        "blesses races here; take the lock explicitly or "
+                        "drop the annotation",
+                    ))
+                # continue assuming the annotation (reported once above) to
+                # avoid cascading findings down the same chain
+                base = held | ann
+                view = project.owner_class(file, func)
+                for call, callee_fk in index.resolved[func_key(file, func)]:
+                    next_held = base | names.qualify_all(
+                        project, view, call.held)
+                    stack.append((*index.funcs[callee_fk], next_held))
+        return findings

@@ -18,38 +18,8 @@ only scanned if it is itself lowered — the rule catches the direct form.
 
 from __future__ import annotations
 
-import ast
-
-from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
-
-_LOWERING_ATTRS = frozenset({
-    "jit", "shard_map", "lower", "jit_under_mesh", "pallas_call",
-})
-
-
-def _dotted(func: ast.expr) -> str | None:
-    """`a.b.c` -> "a.b.c" (Name/Attribute chains only)."""
-    parts: list[str] = []
-    node = func
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
-
-
-def _is_jit_expr(expr: ast.expr) -> bool:
-    """`jax.jit`, `jit`, `partial(jax.jit, ...)`, `functools.partial(...)`."""
-    dotted = _dotted(expr)
-    if dotted in ("jax.jit", "jit"):
-        return True
-    if isinstance(expr, ast.Call):
-        fn = _dotted(expr.func)
-        if fn in ("partial", "functools.partial") and expr.args:
-            return _is_jit_expr(expr.args[0])
-    return False
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.facts import FileFacts
 
 
 class TracedPurityRule(Rule):
@@ -86,53 +56,33 @@ class TracedPurityRule(Rule):
                 return pattern
         return None
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
-        traced_names: set[str] = set()
-        lambdas: list[tuple[ast.Lambda, str]] = []
-        defs: dict[str, list[ast.FunctionDef]] = {}
-        for node in ast.walk(file.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                defs.setdefault(node.name, []).append(node)
-                if any(_is_jit_expr(d) for d in node.decorator_list):
-                    traced_names.add(node.name)
-            elif isinstance(node, ast.Call):
-                fn = _dotted(node.func)
-                is_lowering = (
-                    fn in ("jax.jit", "jit")
-                    or (isinstance(node.func, ast.Attribute)
-                        and node.func.attr in _LOWERING_ATTRS)
-                )
-                if is_lowering and node.args:
-                    target = node.args[0]
-                    if isinstance(target, ast.Name):
-                        traced_names.add(target.id)
-                    elif isinstance(target, ast.Lambda):
-                        lambdas.append((target, fn or node.func.attr))
-
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
+        traced_names = {name for name, _via in file.lowered_names}
         findings: list[Finding] = []
 
-        def scan(body_node: ast.AST, owner: str) -> None:
-            for sub in ast.walk(body_node):
-                if not isinstance(sub, ast.Call):
-                    continue
-                dotted = _dotted(sub.func)
-                if dotted is None:
-                    continue
-                pattern = self._banned_match(dotted)
-                if pattern is not None:
+        def scan(root_func, owner: str) -> None:
+            for func in project.subtree(file, root_func):
+                for call_idx in func.calls:
+                    call = file.calls[call_idx]
+                    if call.dotted is None:
+                        continue
+                    pattern = self._banned_match(call.dotted)
+                    if pattern is None:
+                        continue
                     findings.append(Finding(
-                        self.name, file.path, sub.lineno, sub.col_offset,
-                        f"host call {dotted}() inside traced function "
+                        self.name, file.path, call.line, call.col,
+                        f"host call {call.dotted}() inside traced function "
                         f"`{owner}` (matches banned pattern {pattern!r}) — "
                         "traced programs must be pure: the value burns "
                         "into the compiled graph at trace time",
                     ))
 
-        for name in sorted(traced_names):
-            for fn_def in defs.get(name, []):
-                scan(fn_def, name)
-        for lam, via in lambdas:
-            scan(lam, f"<lambda via {via}>")
+        for func in file.functions:
+            if func.kind == "lambda":
+                if func.lowered_via is not None:
+                    scan(func, f"<lambda via {func.lowered_via}>")
+            elif func.jit_decorated or func.name in traced_names:
+                scan(func, func.name)
 
         # module-wide bans: in files under a configured path prefix, the
         # banned pattern is illegal at ANY scope, not just traced bodies —
@@ -144,20 +94,17 @@ class TracedPurityRule(Rule):
         ]
         if module_patterns:
             seen = {(f.line, f.col) for f in findings}
-            for sub in ast.walk(file.tree):
-                if not isinstance(sub, ast.Call):
-                    continue
-                dotted = _dotted(sub.func)
-                if dotted is None:
+            for call in file.calls:
+                if call.dotted is None:
                     continue
                 for pattern in module_patterns:
-                    if not self._match(dotted, pattern):
+                    if not self._match(call.dotted, pattern):
                         continue
-                    if (sub.lineno, sub.col_offset) in seen:
+                    if (call.line, call.col) in seen:
                         break
                     findings.append(Finding(
-                        self.name, file.path, sub.lineno, sub.col_offset,
-                        f"call {dotted}() matches pattern {pattern!r} "
+                        self.name, file.path, call.line, call.col,
+                        f"call {call.dotted}() matches pattern {pattern!r} "
                         f"banned module-wide under this path "
                         "(banned-module-calls) — draws here must flow "
                         "through the subsystem's seeded rng so trace "

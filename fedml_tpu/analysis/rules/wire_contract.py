@@ -20,12 +20,8 @@ hang off these key constants. Three checks:
 
 from __future__ import annotations
 
-import ast
-import re
-
-from fedml_tpu.analysis.core import Finding, Project, Rule, SourceFile
-
-_KEY_RE = re.compile(r"^MSG_ARG_KEY_\w+$")
+from fedml_tpu.analysis.core import Finding, Project, Rule
+from fedml_tpu.analysis.facts import FileFacts
 
 
 class WireContractRule(Rule):
@@ -39,114 +35,47 @@ class WireContractRule(Rule):
         self.defs: dict[str, tuple[str, str, int, int]] = {}
         # canonical value -> canonical name (first definition wins)
         self.values: dict[str, str] = {}
-        # positions of the defining Constant nodes (skipped by the
-        # duplicate-literal scan): (path, line, col)
-        self.def_value_sites: set[tuple[str, int, int]] = set()
         # usage tallies per key name
         self.written: set[str] = set()
         self.read: set[str] = set()
 
     # -- pass 1: definitions + usages ---------------------------------------
 
-    def collect(self, file: SourceFile, project: Project) -> None:
-        for node in ast.walk(file.tree):
-            if isinstance(node, ast.ClassDef):
-                for stmt in node.body:
-                    self._collect_def(file, stmt)
-        for node in ast.walk(file.tree):
-            if isinstance(node, ast.Call):
-                self._collect_call(node)
-            elif isinstance(node, ast.Subscript):
-                self._mark(node.slice, read=True, written=True)
-            elif isinstance(node, ast.Dict):
-                for key in node.keys:
-                    if key is not None:
-                        self._mark(key, written=True)
-            elif isinstance(node, ast.Compare):
-                for comp in [node.left, *node.comparators]:
-                    self._mark(comp, read=True, written=True)
-
-    def _collect_def(self, file: SourceFile, stmt: ast.stmt) -> None:
-        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1):
-            return
-        target = stmt.targets[0]
-        if not (isinstance(target, ast.Name) and _KEY_RE.match(target.id)):
-            return
-        if isinstance(stmt.value, ast.Constant) and isinstance(
-                stmt.value.value, str):
-            value = stmt.value.value
-            self.defs.setdefault(
-                target.id, (value, file.path, stmt.lineno, stmt.col_offset)
-            )
-            self.values.setdefault(value, target.id)
-            self.def_value_sites.add(
-                (file.path, stmt.value.lineno, stmt.value.col_offset)
-            )
+    def collect(self, file: FileFacts, project: Project) -> None:
+        for cf in file.classes:
+            for name, (value, line, col, _vl, _vc) in cf.wire_defs.items():
+                self.defs.setdefault(name, (value, file.path, line, col))
+                self.values.setdefault(value, name)
         # alias definitions (`MyMessage.K = Message.K`) need no tracking:
         # both spellings share the attribute name, so usage sites of either
         # already tally against the same canonical key
-
-    def _key_name(self, node: ast.expr) -> str | None:
-        if isinstance(node, ast.Attribute) and _KEY_RE.match(node.attr):
-            return node.attr
-        if isinstance(node, ast.Name) and _KEY_RE.match(node.id):
-            return node.id
-        return None
-
-    def _mark(self, node: ast.expr, read: bool = False,
-              written: bool = False) -> None:
-        name = self._key_name(node)
-        if name is None:
-            return
-        if read:
-            self.read.add(name)
-        if written:
-            self.written.add(name)
-
-    def _collect_call(self, node: ast.Call) -> None:
-        func = node.func
-        if not isinstance(func, ast.Attribute) or not node.args:
-            return
-        if func.attr == "add_params":
-            self._mark(node.args[0], written=True)
-        elif func.attr in ("get", "pop"):
-            self._mark(node.args[0], read=True)
-        else:
-            # any other call position (pack helpers, encode framing):
-            # conservatively counts as both — the rule targets NEVER-used
-            # directions, not exotic plumbing
-            for arg in node.args:
-                self._mark(arg, read=True, written=True)
+        self.written |= file.wire_written
+        self.read |= file.wire_read
 
     # -- pass 2 -------------------------------------------------------------
 
-    def check(self, file: SourceFile, project: Project) -> list[Finding]:
+    def check(self, file: FileFacts, project: Project) -> list[Finding]:
         findings: list[Finding] = []
-        for node in ast.walk(file.tree):
-            if (isinstance(node, ast.Constant) and isinstance(node.value, str)
-                    and node.value in self.values):
-                site = (file.path, node.lineno, node.col_offset)
-                if site in self.def_value_sites:
-                    continue
-                findings.append(Finding(
-                    self.name, file.path, node.lineno, node.col_offset,
-                    f"raw string {node.value!r} duplicates wire key "
-                    f"{self.values[node.value]} — use the constant (two "
-                    "spellings of one wire field drift independently)",
-                ))
-            elif (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "add_params" and node.args
-                    and isinstance(node.args[0], ast.Constant)
-                    and isinstance(node.args[0].value, str)
-                    and node.args[0].value not in self.values):
-                findings.append(Finding(
-                    self.name, file.path, node.args[0].lineno,
-                    node.args[0].col_offset,
-                    f"ad-hoc wire key {node.args[0].value!r} passed to "
-                    "add_params — define a MSG_ARG_KEY_* constant so the "
-                    "field is part of the checked contract",
-                ))
+        for value, line, col in file.str_consts:
+            if value not in self.values:
+                continue
+            if (line, col) in file.wire_def_sites:
+                continue
+            findings.append(Finding(
+                self.name, file.path, line, col,
+                f"raw string {value!r} duplicates wire key "
+                f"{self.values[value]} — use the constant (two "
+                "spellings of one wire field drift independently)",
+            ))
+        for value, line, col in file.add_params_literals:
+            if value in self.values:
+                continue  # reported above as a duplicate literal
+            findings.append(Finding(
+                self.name, file.path, line, col,
+                f"ad-hoc wire key {value!r} passed to "
+                "add_params — define a MSG_ARG_KEY_* constant so the "
+                "field is part of the checked contract",
+            ))
         return findings
 
     def finalize(self, project: Project) -> list[Finding]:

@@ -371,8 +371,14 @@ class EdgeAggregatorManager(DistributedManager):
                 done = self.aggregator.add_local_trained_result(
                     sender - 1, flat, n)
             self._last_child_round[sender] = self._round
-            if done:
-                self._forward_partial()
+            out = self._build_partial_msg() if done else None
+        # the upstream send runs OUTSIDE the critical section (fedlint
+        # blocking-under-lock): a slow or retrying up fabric must not stall
+        # child folds or the up thread's round advance — ordering is safe
+        # because the next window cannot complete before the parent's next
+        # sync, which needs this partial first
+        if out is not None:
+            self._send_up(out)
 
     def _on_child_partial(self, msg: Message) -> None:
         with self._edge_lock:
@@ -391,10 +397,15 @@ class EdgeAggregatorManager(DistributedManager):
                 done = self.aggregator.add_partial_result(
                     sender - 1, part, wsum)
             self._last_child_round[sender] = self._round
-            if done:
-                self._forward_partial()
+            out = self._build_partial_msg() if done else None
+        if out is not None:  # send outside the lock (see _on_child_model)
+            self._send_up(out)
 
-    def _forward_partial(self) -> None:  # lock-held: _edge_lock
+    def _build_partial_msg(self) -> Message:  # lock-held: _edge_lock
+        """Snapshot the completed window into the upstream partial message.
+        Caller sends it AFTER releasing ``_edge_lock`` — the build touches
+        the tally and the telemetry counters (lock territory), the send is
+        blocking I/O (never lock territory)."""
         partial, wsum, count = self.aggregator.partial()
         self.total_folds += int(count)
         with trace.span("tree/forward", round=self._round, folds=count,
@@ -430,7 +441,7 @@ class EdgeAggregatorManager(DistributedManager):
                         (time.perf_counter() - self._window_t0) * 1e3, 3)
                 self._window_t0 = None
                 out.add_params(Message.MSG_ARG_KEY_TELEMETRY, tel)
-            self._send_up(out)
+            return out
 
 
 class TreeFedAvgServerManager(FedAvgServerManager):

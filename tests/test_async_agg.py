@@ -504,6 +504,45 @@ def test_edge_absorbs_duplicate_after_partial_forward():
     assert edge.duplicate_uploads == 1 and edge.stale_uploads == 0
 
 
+def test_edge_forwards_partial_outside_edge_lock():
+    """The upstream partial send must run with ``_edge_lock`` RELEASED
+    (fedlint blocking-under-lock, PR 15): a slow or retrying up fabric held
+    under the lock would stall every child fold AND the up thread's round
+    advance — the PR 10 deadlock shape. The build (tally snapshot,
+    telemetry counters) stays inside the critical section; only the send
+    moves out."""
+    from fedml_tpu.async_agg.tree import EdgeAggregatorManager
+
+    up_fabric, down_fabric = LoopbackFabric(2), LoopbackFabric(3)
+    edge = EdgeAggregatorManager(
+        up_comm=LoopbackCommManager(up_fabric, 1), up_rank=1,
+        down_comm=LoopbackCommManager(down_fabric, 0), child_num=2,
+        leaf_base=0, leaf_total=2, client_num_in_total=2,
+        children_are_leaves=True,
+    )
+    edge.register_message_receive_handlers()
+    lock_free_at_send = []
+    inner_send = edge.up_comm.send_message
+
+    def probed_send(msg):
+        free = edge._edge_lock.acquire(blocking=False)
+        if free:
+            edge._edge_lock.release()
+        lock_free_at_send.append(free)
+        return inner_send(msg)
+
+    edge.up_comm.send_message = probed_send
+    x = np.ones(8, np.float32)
+    edge._on_child_model(_upload(1, 0, x, n=2.0))
+    edge._on_child_model(_upload(2, 0, x, n=3.0))
+    assert up_fabric.queues[0].qsize() == 1  # the partial still forwards
+    assert lock_free_at_send == [True]  # ... with the lock released
+    # the forwarded partial is intact (snapshot happened under the lock)
+    part = Message.from_bytes(up_fabric.queues[0].get_nowait())
+    assert part.get(Message.MSG_ARG_KEY_WEIGHT_SUM) == 5.0
+    assert part.get(MyMessage.MSG_ARG_KEY_ROUND_IDX) == 0
+
+
 def test_edge_discards_stale_window_when_parent_advances():
     """If the root times out a round while this tier's window is only
     partially filled (one slow child), the next parent sync advances the

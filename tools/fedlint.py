@@ -1,14 +1,21 @@
 """fedlint CLI: the repo's invariant gate (docs/STATIC_ANALYSIS.md).
 
-    python tools/fedlint.py [paths...] [--format text|json]
+    python tools/fedlint.py [paths...] [--format text|json|sarif]
                             [--select rule,rule] [--list-rules]
+                            [--baseline report.json] [--no-cache]
 
 Paths and rule selection default to the ``[tool.fedlint]`` section of
-pyproject.toml. Exit status: 0 when there are zero live findings (waived
+pyproject.toml. Per-file analysis facts are cached under
+``.fedlint_cache/`` keyed on (path, mtime, size); ``--no-cache`` forces a
+full re-parse. Exit status: 0 when there are zero live findings (waived
 findings with a justification are enumerated but do not fail the gate);
 1 when any finding is live — including unjustified or unused waivers,
-which surface as rule ``waiver`` findings. Tier-1 runs this in-process
-over ``fedml_tpu/`` and ``tools/`` (tests/test_static_analysis.py).
+which surface as rule ``waiver`` findings. With ``--baseline`` the gate
+fails only on findings NOT present in the saved ``--format json`` report
+(matched on rule+path+message, so line drift never re-flags old
+findings); carried findings are summarized, new ones rendered in full.
+Tier-1 runs this in-process over ``fedml_tpu/`` and ``tools/``
+(tests/test_static_analysis.py).
 """
 
 from __future__ import annotations
@@ -24,22 +31,34 @@ REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 def run(paths: list[str] | None = None, fmt: str = "text",
         select: list[str] | None = None, root: str | None = None,
-        out=None) -> int:
+        out=None, err=None, baseline: str | None = None,
+        use_cache: bool = True, cache_dir: str | None = None) -> int:
     """Programmatic entry (the tier-1 gate calls this in-process).
     Returns the process exit code; the rendered report goes to ``out``
-    (default stdout)."""
+    (default stdout), diagnostics (the baseline carried-count line) to
+    ``err`` (default stderr) so json/sarif stdout stays parseable.
+
+    The facts cache is used only for default-scope scans (no explicit
+    ``paths``) unless ``cache_dir`` is given: the sidecar is pruned to
+    each run's scan set, so letting an explicit narrow scan touch the
+    repo-default sidecar would wipe the whole-tree warm cache."""
     import dataclasses
 
     from fedml_tpu.analysis import (
         load_config,
         make_rules,
-        render_json,
-        render_text,
         run_analysis,
     )
-    from fedml_tpu.analysis.report import live_findings
+    from fedml_tpu.analysis.report import (
+        RENDERERS,
+        live_findings,
+        load_baseline,
+        render_sarif,
+        split_by_baseline,
+    )
 
     out = out or sys.stdout
+    err = err or sys.stderr
     root = root or REPO_ROOT
     config = load_config(root)
     if select:
@@ -47,14 +66,38 @@ def run(paths: list[str] | None = None, fmt: str = "text",
     scan_paths = list(paths) if paths else [
         os.path.join(root, p) for p in config.paths
     ]
+    if paths and cache_dir is None:
+        use_cache = False  # see docstring: protect the default sidecar
     rules = make_rules(config)
     findings, waivers, scanned = run_analysis(
         scan_paths, rules, exclude=config.exclude, root=root,
+        cache_dir=cache_dir, use_cache=use_cache,
     )
-    renderer = render_json if fmt == "json" else render_text
-    print(renderer(findings, waivers, scanned, [r.name for r in rules]),
-          file=out)
-    return 1 if live_findings(findings) else 0
+    rule_names = [r.name for r in rules]
+
+    gating = live_findings(findings)
+    if baseline is not None:
+        known = load_baseline(baseline)
+        new, carried = split_by_baseline(findings, known)
+        gating = new
+        # render only what the change introduced (plus the always-on
+        # waiver enumeration); carried findings are counted, not repeated
+        findings = [f for f in findings if f.waived or f in new]
+        if carried:
+            # diagnostics, NOT part of the report: stdout must stay a
+            # single parseable json/sarif document
+            print(f"baseline: {len(carried)} carried finding(s) "
+                  f"suppressed, {len(new)} new", file=err)
+
+    if fmt == "sarif":
+        rendered = render_sarif(
+            findings, waivers, scanned, rule_names,
+            rule_descriptions={r.name: r.description for r in rules},
+        )
+    else:
+        rendered = RENDERERS[fmt](findings, waivers, scanned, rule_names)
+    print(rendered, file=out)
+    return 1 if gating else 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -64,12 +107,22 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("paths", nargs="*",
                         help="files/directories to scan (default: "
                              "[tool.fedlint] paths)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
     parser.add_argument("--select",
                         help="comma-separated rule names (default: "
                              "[tool.fedlint] select)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule registry and exit")
+    parser.add_argument("--baseline", metavar="REPORT.json",
+                        help="previously saved --format json report: fail "
+                             "only on findings not present in it")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write the .fedlint_cache "
+                             "facts sidecar (full re-parse)")
+    parser.add_argument("--cache-dir", default=None,
+                        help="facts cache location (default: "
+                             "<root>/.fedlint_cache)")
     args = parser.parse_args(argv)
     if args.list_rules:
         from fedml_tpu.analysis import all_rules
@@ -78,7 +131,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{name}: {cls.description}")
         return 0
     select = [s.strip() for s in args.select.split(",")] if args.select else None
-    return run(args.paths or None, fmt=args.format, select=select)
+    return run(args.paths or None, fmt=args.format, select=select,
+               baseline=args.baseline, use_cache=not args.no_cache,
+               cache_dir=args.cache_dir)
 
 
 if __name__ == "__main__":
