@@ -31,7 +31,7 @@ from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
 from fedml_tpu.comm.send_pool import BroadcastSendError
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_train
-from fedml_tpu.obs import registry
+from fedml_tpu.obs import jobscope, registry
 from fedml_tpu.obs import trace
 from fedml_tpu.sim.cohort import FederatedArrays, stack_cohort
 
@@ -720,7 +720,12 @@ class FedAvgServerManager(ServerManager):
             if not all_received and self.round_timeout is not None:
                 if self._round_timer is None:
                     self._round_timer = threading.Timer(
-                        self.round_timeout, self._round_timed_out, args=(current,)
+                        self.round_timeout,
+                        # timer fires on its own thread: inherit the server
+                        # thread's job binding so the timeout path's spans/
+                        # counters stay job-scoped (obs/jobscope.py)
+                        jobscope.wrap_target(self._round_timed_out),
+                        args=(current,),
                     )
                     self._round_timer.daemon = True
                     self._round_timer.start()
@@ -1300,7 +1305,11 @@ def run_manager_protocol(server, clients, join_timeout: float = 30.0) -> None:
     an injected crash, comm/faults.py), the client transports are stopped
     so their threads unblock before the error propagates — a crashed server
     must not leak parked client threads into the next (restarted) run."""
-    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    # client threads inherit the caller's job binding (obs/jobscope.py):
+    # under the multi-tenant runner a job's clients emit into ITS job-scoped
+    # registry/tracer; single-job runs get the target back unchanged
+    threads = [threading.Thread(target=jobscope.wrap_target(c.run),
+                                daemon=True) for c in clients]
     for t in threads:
         t.start()
     server.register_message_receive_handlers()

@@ -104,6 +104,13 @@ class Message:
     # never read by the aggregation path, so telemetry-on runs stay
     # bit-identical to telemetry-off runs
     MSG_ARG_KEY_TELEMETRY = "telemetry"
+    # multi-tenant job plane (fedml_tpu/tenancy/, docs/MULTITENANCY.md): the
+    # federation a message belongs to when several jobs share one wire — a
+    # header-only scalar stamped by the job's comm facade and read by the
+    # server-side router to demux per-job state. OPTIONAL: a message with no
+    # job id routes to the implicit default job, so a single-job run's wire
+    # bytes and behavior are unchanged (tools/multijob_smoke.py).
+    MSG_ARG_KEY_JOB_ID = "job_id"
 
     def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {

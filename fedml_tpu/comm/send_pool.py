@@ -121,6 +121,17 @@ class SendWorkerPool:
         if errors:
             raise BroadcastSendError(errors)
 
+    def submit(self, dst: int, fn: Callable[[], None]) -> None:
+        """Non-barrier enqueue: run ``fn`` on ``dst``'s worker, in submission
+        order with every other send to ``dst``, and return immediately.
+        Completion/error signaling is the caller's job (``fn`` must capture
+        its own done/error channel) — the fair fan-out scheduler
+        (tenancy/scheduler.py) dispatches its deficit-round-robin legs
+        through this, keeping the per-destination FIFO contract while jobs'
+        fan-outs interleave."""
+        self._ensure_started()
+        self._queues[hash(dst) % self.workers].put(fn)
+
     def close(self, timeout: float = 5.0) -> None:
         """Stop the workers (idempotent). Queued work submitted before close
         still drains; ``run_all`` after close raises."""

@@ -167,8 +167,13 @@ class HeartbeatSender:
 
     def start(self) -> "HeartbeatSender":
         if self._thread is None:
+            from fedml_tpu.obs import jobscope
+
             self._thread = threading.Thread(
-                target=self._loop, name=f"heartbeat-c{self.client_id}",
+                # inherit the starter's job binding (obs/jobscope.py): a
+                # multi-tenant job's heartbeats trace/count into ITS scope
+                target=jobscope.wrap_target(self._loop),
+                name=f"heartbeat-c{self.client_id}",
                 daemon=True,
             )
             self._thread.start()

@@ -79,6 +79,17 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="broadcast send-pool width on the gRPC "
                              "transport; 0 = serial fan-out on the manager "
                              "thread (docs/PERFORMANCE.md server wire path)")
+    # multi-tenant job plane (fedml_tpu/tenancy, docs/MULTITENANCY.md)
+    parser.add_argument("--jobs", type=str, default=None,
+                        help="path to a JSON job list: N federations "
+                             "co-scheduled over ONE shared wire, send pool "
+                             "and process (fedml_tpu/tenancy, "
+                             "docs/MULTITENANCY.md). Each entry is an "
+                             "object {\"job_id\": <name>, <flag>: <value>, "
+                             "...} overriding the training/codec/defense "
+                             "flags below per job; the CLI flags are the "
+                             "defaults every job inherits. Requires "
+                             "--backend loopback")
     # barrier-free server plane (fedml_tpu/async_agg, docs/PERFORMANCE.md
     # "Barrier-free aggregation"); message-passing backends only
     parser.add_argument("--server_mode", type=str, default="sync",
@@ -364,14 +375,40 @@ def build_aggregator(args, train_data):
     )
 
 
+def _make_eval_fn(trainer, ds, eval_batch_size: int = 256):
+    """Jitted full-test-set eval over the dataset's test arrays (the
+    message-passing harness's per-round ``ev``); None when the dataset
+    ships no test split."""
+    if ds.test_arrays is None:
+        return None
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.core import scan as scanlib
+    from fedml_tpu.sim import cohort as cohortlib
+
+    test_batches = jax.tree.map(
+        jnp.asarray, cohortlib.batch_array(ds.test_arrays, eval_batch_size)
+    )
+
+    @jax.jit
+    def ev(variables):
+        def step(c, b):
+            return c, trainer.eval_batch(variables, b)
+
+        _, m = scanlib.scan(step, 0, test_batches)
+        s = jax.tree.map(lambda x: jnp.sum(x, 0), m)
+        tot = jnp.maximum(s["test_total"], 1.0)
+        return s["test_correct"] / tot, s["test_loss"] / tot
+
+    return ev
+
+
 def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     """Drive the real distributed FedAvg protocol (typed array messages,
     server + worker managers) over the selected transport. Reference run
     shape: mpirun W+1 processes (run_fedavg_distributed_pytorch.sh:21); here
     rank threads on loopback queues / native shm rings / localhost gRPC."""
-    import jax
-    import jax.numpy as jnp
-
     import functools
 
     from fedml_tpu.algorithms.fedavg_distributed import (
@@ -380,25 +417,8 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         run_distributed_fedavg_mqtt_s3,
         run_distributed_fedavg_shm,
     )
-    from fedml_tpu.sim import cohort as cohortlib
 
-    ev = None
-    if ds.test_arrays is not None:
-        test_batches = jax.tree.map(
-            jnp.asarray, cohortlib.batch_array(ds.test_arrays, cfg.eval_batch_size)
-        )
-
-        @jax.jit
-        def ev(variables):
-            def step(c, b):
-                return c, trainer.eval_batch(variables, b)
-
-            from fedml_tpu.core import scan as scanlib
-
-            _, m = scanlib.scan(step, 0, test_batches)
-            s = jax.tree.map(lambda x: jnp.sum(x, 0), m)
-            tot = jnp.maximum(s["test_total"], 1.0)
-            return s["test_correct"] / tot, s["test_loss"] / tot
+    ev = _make_eval_fn(trainer, ds, cfg.eval_batch_size)
 
     history: list[dict] = []
 
@@ -641,6 +661,236 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     return history
 
 
+# per-job override keys the --jobs entries may carry: the core training /
+# codec / defense flags. Everything else (fault injection, retry/liveness,
+# checkpointing, topology modes) stays single-job and is rejected loudly in
+# _reject_multijob_conflicts — never silently dropped.
+_JOBS_OVERRIDE_KEYS = frozenset({
+    "model", "dataset", "data_dir", "partition_method", "partition_alpha",
+    "dataidx_map_path", "client_num_in_total", "client_num_per_round",
+    "batch_size", "client_optimizer", "lr", "wd", "momentum", "epochs",
+    "comm_round", "frequency_of_the_test", "seed", "algorithm",
+    "fedprox_mu", "robust_rule", "norm_bound", "stddev", "reservoir_k",
+    "compressor", "topk_frac", "quantize_bits", "error_feedback",
+    "downlink_compressor", "downlink_keyframe_every", "downlink_retention",
+    "model_dtype",
+})
+
+
+def _reject_multijob_conflicts(args) -> None:
+    """Flag-combination gate for --jobs: fail before any data/model work
+    (the same loud-rejection convention as the sim/tree guards in _run)."""
+    if args.backend != "loopback":
+        raise NotImplementedError(
+            "--jobs co-schedules every job's federation over ONE shared "
+            "endpoint with job-id demux (fedml_tpu/tenancy); only the "
+            "loopback transport has the shared-fabric wiring — pick "
+            "--backend loopback"
+        )
+    if getattr(args, "server_mode", "sync") != "sync":
+        raise NotImplementedError(
+            f"--server_mode {args.server_mode} reshapes the single server "
+            "plane the jobs share; --jobs runs each job's sync round "
+            "protocol — pick --server_mode sync"
+        )
+    if getattr(args, "is_mobile", 0):
+        raise NotImplementedError(
+            "--is_mobile selects the JSON nested-list wire format, which "
+            "is not wired through the shared job plane; pick one"
+        )
+    unwired = [
+        flag for flag, val in [
+            ("--fault_spec", getattr(args, "fault_spec", None)),
+            ("--population", getattr(args, "population", None)),
+            ("--send_retries", getattr(args, "send_retries", 0)),
+            ("--heartbeat_interval", getattr(args, "heartbeat_interval", 0.0)),
+            ("--checkpoint_dir", getattr(args, "checkpoint_dir", None)),
+            ("--resume", getattr(args, "resume", 0)),
+            ("--init_from", getattr(args, "init_from", None)),
+            ("--save_params_to", getattr(args, "save_params_to", None)),
+        ] if val
+    ]
+    if unwired:
+        # consumed by the single-job harness this branch bypasses; ignoring
+        # them silently would fake a robustness or recovery experiment
+        raise NotImplementedError(
+            f"{', '.join(unwired)} not wired into --jobs yet: the "
+            "multi-tenant entry wires the training/codec/defense planes "
+            "per job — drive tenancy.run_multi_job(run_kwargs=...) "
+            "directly for the fault/retry/liveness/checkpoint planes"
+        )
+
+
+def _multijob_run_kwargs(overlay):
+    """One job's composition kwargs for run_distributed_fedavg (the --jobs
+    subset of the single-job harness planes: uplink codec, downlink delta
+    coding, robust defense). Returns (run_kwargs, stats_dicts) where each
+    stats dict fills with per-round records to merge into the job's
+    metric stream."""
+    run_kwargs: dict = {}
+    comm_stats: dict = {}
+    robust_stats: dict = {}
+    if getattr(overlay, "compressor", "none") != "none":
+        from fedml_tpu.compress import make_codec
+
+        run_kwargs.update(
+            codec=make_codec(overlay.compressor, topk_frac=overlay.topk_frac,
+                             quantize_bits=overlay.quantize_bits),
+            error_feedback=bool(overlay.error_feedback),
+            comm_stats=comm_stats,
+        )
+    if getattr(overlay, "downlink_compressor", "none") != "none":
+        from fedml_tpu.compress.downlink import resolve_downlink_codec
+
+        downlink_codec = resolve_downlink_codec(
+            overlay.downlink_compressor, topk_frac=overlay.topk_frac,
+            quantize_bits=overlay.quantize_bits,
+        )
+        if downlink_codec is not None:
+            run_kwargs.update(
+                downlink_codec=downlink_codec,
+                downlink_keyframe_every=getattr(
+                    overlay, "downlink_keyframe_every", 8),
+                downlink_retention=getattr(overlay, "downlink_retention", 4),
+            )
+            if "comm_stats" not in run_kwargs:
+                run_kwargs["comm_stats"] = comm_stats
+    if overlay.algorithm == "fedavg_robust":
+        from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+        run_kwargs.update(
+            robust_config=RobustDistConfig(
+                rule=overlay.robust_rule, norm_bound=overlay.norm_bound,
+                dp_stddev=overlay.stddev, dp_seed=overlay.seed,
+                reservoir_k=getattr(overlay, "reservoir_k", 0),
+            ),
+            robust_stats=robust_stats,
+        )
+    return run_kwargs, [comm_stats, robust_stats]
+
+
+def _run_multi_job(args, metrics) -> list[dict]:
+    """--jobs harness: load the JSON job list, build each job's data/model/
+    trainer from the overlaid flags, and hand the whole set to
+    tenancy.run_multi_job — one shared wire, send pool, and scheduler
+    (docs/MULTITENANCY.md). Each job's per-round records (Comm/*, Robust/*,
+    Test/* at the job's test frequency) are logged tagged with its name;
+    with --fleet_stats DIR the runner writes DIR/<job>/fleet.jsonl +
+    DIR/jobs.json."""
+    import copy
+    import json
+
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.data import load_partition_data
+    from fedml_tpu.models import create_model
+    from fedml_tpu.tenancy import JobSpec, job_key, run_multi_job
+
+    with open(args.jobs) as f:
+        entries = json.load(f)
+    if not isinstance(entries, list) or not entries:
+        raise ValueError(
+            f"--jobs {args.jobs}: expected a non-empty JSON list of job "
+            "objects (docs/MULTITENANCY.md 'Job specs')"
+        )
+    specs: list[JobSpec] = []
+    hist_by_job: dict[str, list[dict]] = {}
+    for i, entry in enumerate(entries):
+        if not isinstance(entry, dict):
+            raise ValueError(
+                f"--jobs entry {i} is not a JSON object: {entry!r}")
+        entry = dict(entry)
+        # the spec field is deliberately spelled like the wire header the
+        # name becomes (docs/MULTITENANCY.md "The wire header")
+        job_id = entry.pop(Message.MSG_ARG_KEY_JOB_ID, None)
+        if job_id is None and len(entries) > 1:
+            raise ValueError(
+                f"--jobs entry {i} has no job_id — with more than one job "
+                "every entry needs a unique name on the shared wire"
+            )
+        unknown = sorted(set(entry) - _JOBS_OVERRIDE_KEYS)
+        if unknown:
+            raise ValueError(
+                f"--jobs entry {i} ({job_key(job_id)}): unknown override "
+                f"keys {unknown}; supported: {sorted(_JOBS_OVERRIDE_KEYS)}"
+            )
+        overlay = copy.copy(args)
+        for k, v in entry.items():
+            setattr(overlay, k, v)
+        if overlay.algorithm not in ("fedavg", "fedprox", "fedavg_robust"):
+            raise NotImplementedError(
+                f"--jobs entry {job_key(job_id)}: --algorithm "
+                f"{overlay.algorithm} is sim-engine only; the job plane "
+                "runs the message-passing protocol (fedavg | fedprox | "
+                "fedavg_robust)"
+            )
+        ds = load_partition_data(
+            overlay.dataset, overlay.data_dir, overlay.partition_method,
+            overlay.partition_alpha, overlay.client_num_in_total,
+            overlay.seed,
+            dataidx_map_path=getattr(overlay, "dataidx_map_path", None),
+        )
+        model = create_model(overlay.model, ds.class_num, overlay.dataset,
+                             dtype=getattr(overlay, "model_dtype", None))
+        trainer = build_trainer(overlay, model, overlay.dataset)
+        run_kwargs, stats_dicts = _multijob_run_kwargs(overlay)
+        name = job_key(job_id)
+        history = hist_by_job.setdefault(name, [])
+        ev = _make_eval_fn(trainer, ds)
+        freq = max(overlay.frequency_of_the_test
+                   if not overlay.ci else overlay.comm_round, 1)
+        last = overlay.comm_round - 1
+
+        def on_round(r, variables, name=name, history=history, ev=ev,
+                     stats_dicts=stats_dicts, freq=freq, last=last):
+            rec = {"job": name, "round": r}
+            for stats in stats_dicts:
+                for srec in stats.get("rounds", []):
+                    if srec.get("round") == r:
+                        rec.update({k: v for k, v in srec.items()
+                                    if k != "round"})
+            if ev is not None and ((r + 1) % freq == 0 or r == last):
+                acc, loss = ev(variables)
+                rec.update({"Test/Acc": float(acc),
+                            "Test/Loss": float(loss)})
+            history.append(rec)
+
+        specs.append(JobSpec(
+            trainer=trainer, train_data=ds.train,
+            worker_num=min(overlay.client_num_per_round,
+                           ds.train.num_clients),
+            round_num=overlay.comm_round, batch_size=overlay.batch_size,
+            job_id=job_id, seed=overlay.seed, on_round=on_round,
+            fleet=bool(getattr(args, "fleet_stats", None)),
+            run_kwargs=run_kwargs,
+        ))
+    out_dir = getattr(args, "fleet_stats", None)
+    logging.info("--jobs: co-scheduling %d jobs (%d workers total) over "
+                 "one shared wire", len(specs),
+                 sum(s.worker_num for s in specs))
+    results = run_multi_job(specs, out_dir=out_dir)
+    history: list[dict] = []
+    failed: dict[str, BaseException] = {}
+    for spec in specs:
+        res = results[spec.name]
+        for rec in hist_by_job.get(spec.name, []):
+            metrics.log(rec)
+            history.append(rec)
+        logging.info("job %s: totals %s", spec.name, res.totals)
+        if res.error is not None:
+            failed[spec.name] = res.error
+    if out_dir:
+        logging.info("per-job telemetry written to %s (jobs.json + "
+                     "<job>/fleet.jsonl)", out_dir)
+    if failed:
+        # neighbors' results are already logged/written above — the CLI
+        # still has to exit nonzero when any tenant failed
+        raise RuntimeError(
+            f"{len(failed)}/{len(specs)} jobs failed: "
+            + "; ".join(f"{n}: {e!r}" for n, e in sorted(failed.items()))
+        )
+    return history
+
+
 def run(args) -> list[dict]:
     from fedml_tpu.obs.trace import run_traced
 
@@ -657,6 +907,15 @@ def _run(args) -> list[dict]:
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
     logging_config(0)
+    if getattr(args, "jobs", None):
+        # multi-tenant job plane (fedml_tpu/tenancy, docs/MULTITENANCY.md):
+        # N federations over one shared wire. Gate the flag combos loudly,
+        # then hand off — each job builds its own data/model/trainer from
+        # its overlaid flags inside the harness
+        _reject_multijob_conflicts(args)
+        with MetricsLogger(run_dir=args.run_dir,
+                           use_wandb=bool(args.enable_wandb)) as metrics:
+            return _run_multi_job(args, metrics)
     if getattr(args, "is_mobile", 0) and args.backend == "sim":
         # pure flag-combination error: fail before any data/model work
         raise NotImplementedError(

@@ -80,6 +80,21 @@ ROBUST_UPDATE_NORM = "Robust/UpdateNorm"
 ROBUST_CLIP_FRACTION = "Robust/ClipFraction"
 ROBUST_FILTERED = "Robust/FilteredClients"
 
+# Multi-tenant job plane keys (fedml_tpu/tenancy/, docs/MULTITENANCY.md):
+# per-job accounting when N federations share one wire, one send pool, and
+# one scheduler. SendBytes/SendLegs/SchedulerTurns are emitted by the fair
+# fan-out scheduler's per-job stats (tenancy/scheduler.py — bytes actually
+# dispatched for the job, individual send legs, and deficit-round-robin
+# visits that dispatched work); Rounds/Errors ride each job's totals from
+# the tenancy runner (rounds that closed, 1 if the job died with a captured
+# exception). All land in per-job ``totals`` (jobs.json) and, when a
+# job-scoped registry is installed, in that job's metric stream.
+JOB_SEND_BYTES = "Job/SendBytes"
+JOB_SEND_LEGS = "Job/SendLegs"
+JOB_SCHED_TURNS = "Job/SchedulerTurns"
+JOB_ROUNDS = "Job/Rounds"
+JOB_ERRORS = "Job/Errors"
+
 
 class CommBytesAccountant:
     """Per-round uplink/downlink byte ledger for the message-passing path.

@@ -35,9 +35,12 @@ import threading
 import time
 from typing import Any
 
+from fedml_tpu.obs import jobscope
+
 __all__ = [
     "Histogram", "MetricRegistry", "FleetHealth",
     "install", "uninstall", "get", "enabled",
+    "install_job", "uninstall_job", "job_registries", "merged_snapshot",
     "counter", "gauge", "observe", "add_cli_flag",
     "STATE_READMITTED", "FLEET_JSONL_NAME",
 ]
@@ -222,9 +225,14 @@ class MetricRegistry:
 # ---------------------------------------------------------------------------
 # Process-wide registry + zero-overhead module-level helpers (the
 # install/no-op discipline of obs.trace: one global read when disabled).
+# With the multi-tenant job plane, installs can additionally be job-scoped
+# (obs/jobscope.py): a thread bound to a job resolves that job's registry
+# first and falls back to the process one, so N co-scheduled federations
+# keep separate metric streams while single-job runs are untouched.
 # ---------------------------------------------------------------------------
 
 _registry: MetricRegistry | None = None
+_job_store = jobscope.JobStore("registry")
 
 
 def install(registry: MetricRegistry | None = None) -> MetricRegistry:
@@ -242,31 +250,64 @@ def uninstall() -> MetricRegistry | None:
     return r
 
 
+def install_job(job: str, registry: MetricRegistry | None = None) -> MetricRegistry:
+    """Install a registry scoped to ``job``: threads bound to the job
+    (jobscope.bound / jobscope.wrap_target) resolve it ahead of the process
+    registry. Used by the tenancy runner so each federation's telemetry
+    lands in its own registry."""
+    return _job_store.install(
+        job, registry if registry is not None else MetricRegistry())
+
+
+def uninstall_job(job: str) -> MetricRegistry | None:
+    return _job_store.uninstall(job)
+
+
+def job_registries() -> dict[str, MetricRegistry]:
+    """Snapshot of the installed job-scoped registries (job -> registry)."""
+    return _job_store.installed()
+
+
+def merged_snapshot() -> dict:
+    """Process-level merge view: the process registry's snapshot merged with
+    every job-scoped registry's, through the :meth:`MetricRegistry.merge`
+    composition seam (counters add, gauges last-wins in sorted job order,
+    histograms merge)."""
+    merged = MetricRegistry()
+    if _registry is not None:
+        merged.merge(_registry.snapshot())
+    for _job, reg in sorted(_job_store.installed().items()):
+        merged.merge(reg.snapshot())
+    return merged.snapshot()
+
+
 def get() -> MetricRegistry | None:
-    """The installed process registry, or None. Call sites whose metric
-    *values* are expensive to compute (timers, byte walks) should guard on
-    this before computing them."""
-    return _registry
+    """The calling thread's job-scoped registry when one is installed, else
+    the process registry, else None. Call sites whose metric *values* are
+    expensive to compute (timers, byte walks) should guard on this before
+    computing them."""
+    r = _job_store.lookup()
+    return r if r is not None else _registry
 
 
 def enabled() -> bool:
-    return _registry is not None
+    return get() is not None
 
 
 def counter(name: str, inc: float = 1.0) -> None:
-    r = _registry
+    r = get()
     if r is not None:
         r.counter(name, inc)
 
 
 def gauge(name: str, value: float) -> None:
-    r = _registry
+    r = get()
     if r is not None:
         r.gauge(name, value)
 
 
 def observe(name: str, value: float) -> None:
-    r = _registry
+    r = get()
     if r is not None:
         r.observe(name, value)
 

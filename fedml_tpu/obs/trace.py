@@ -264,10 +264,24 @@ class Tracer:
 
 # ---------------------------------------------------------------------------
 # Process-wide tracer + the zero-overhead module-level helpers every
-# instrumented call site uses.
+# instrumented call site uses. With the multi-tenant job plane, tracer
+# installs can additionally be job-scoped (obs/jobscope.py): a thread bound
+# to a job resolves that job's tracer first and falls back to the process
+# one, so N co-scheduled federations keep separate span streams while
+# single-job runs keep the one-global-read hot path.
 # ---------------------------------------------------------------------------
 
 _tracer: Tracer | None = None
+_job_store = None  # lazily built: jobscope is only imported when job-scoping is used
+
+
+def _job_tracers():
+    global _job_store
+    if _job_store is None:
+        from fedml_tpu.obs import jobscope
+
+        _job_store = jobscope.JobStore("tracer")
+    return _job_store
 
 
 def install(tracer: Tracer | None = None) -> Tracer:
@@ -286,30 +300,53 @@ def uninstall() -> Tracer | None:
     return t
 
 
+def install_job(job: str, tracer: Tracer | None = None) -> Tracer:
+    """Install a tracer scoped to ``job``: threads bound to the job
+    (jobscope.bound / jobscope.wrap_target) resolve it ahead of the process
+    tracer, so each co-scheduled federation exports its own span stream."""
+    return _job_tracers().install(
+        job, tracer if tracer is not None else Tracer())
+
+
+def uninstall_job(job: str) -> Tracer | None:
+    return _job_tracers().uninstall(job)
+
+
+def job_tracers() -> dict[str, Tracer]:
+    """Snapshot of the installed job-scoped tracers (job -> tracer)."""
+    return _job_tracers().installed()
+
+
 def get() -> Tracer | None:
-    """The installed process tracer, or None. Call sites whose span
-    *attributes* are expensive to compute should guard on this."""
+    """The calling thread's job-scoped tracer when one is installed, else
+    the process tracer, else None. Call sites whose span *attributes* are
+    expensive to compute should guard on this."""
+    store = _job_store
+    if store is not None:
+        t = store.lookup()
+        if t is not None:
+            return t
     return _tracer
 
 
 def enabled() -> bool:
-    return _tracer is not None
+    return get() is not None
 
 
 def span(name: str, **attrs: Any):
-    """Span on the process tracer; shared no-op when none is installed."""
-    t = _tracer
+    """Span on the resolved tracer; shared no-op when none is installed."""
+    t = get()
     return t.span(name, **attrs) if t is not None else _NULL_SPAN
 
 
 def event(name: str, **attrs: Any) -> None:
-    t = _tracer
+    t = get()
     if t is not None:
         t.event(name, **attrs)
 
 
 def counter(name: str, value: float, **attrs: Any) -> None:
-    t = _tracer
+    t = get()
     if t is not None:
         t.counter(name, value, **attrs)
 

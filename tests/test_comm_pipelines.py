@@ -128,6 +128,7 @@ def test_splitnn_real_processes(tmp_path):
     """The reference's ACTUAL process model: each client is a separate OS
     process (split_nn/client.py), here joined to the parent's server over
     the native C++ shm ring — bit-identical to the in-process oracle."""
+    import os
     import subprocess
     import sys
     import uuid
@@ -140,14 +141,19 @@ def test_splitnn_real_processes(tmp_path):
 
     job = f"sp_{uuid.uuid4().hex[:8]}"
     workers = []
-    worker_src = str(
-        __import__("pathlib").Path(__file__).parent / "_splitnn_worker.py"
-    )
+    worker_path = __import__("pathlib").Path(__file__).parent / "_splitnn_worker.py"
+    worker_src = str(worker_path)
+    # worker scripts get sys.path[0] = tests/, not the repo root (same
+    # forwarding as tests/test_multihost.py _run_procs)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (str(worker_path.parent.parent) + os.pathsep
+                         + env.get("PYTHONPATH", ""))
     for r, batches in enumerate(cb, start=1):
         npz = tmp_path / f"client{r}.npz"
         np.savez(npz, **{k: np.asarray(v) for k, v in batches.items()})
         workers.append(subprocess.Popen(
-            [sys.executable, worker_src, job, str(r), str(len(cb) + 1), str(npz)]
+            [sys.executable, worker_src, job, str(r), str(len(cb) + 1), str(npz)],
+            env=env,
         ))
 
     # server in THIS process (mirrors run_distributed_splitnn's setup)

@@ -14,6 +14,7 @@ DOCS = [
     DOCS_DIR / "COMPRESSION.md",
     DOCS_DIR / "PERFORMANCE.md",
     DOCS_DIR / "OBSERVABILITY.md",
+    DOCS_DIR / "MULTITENANCY.md",
     DOCS_DIR / "ROBUSTNESS.md",
     DOCS_DIR / "STATIC_ANALYSIS.md",
 ]

@@ -35,6 +35,7 @@ def test_main_fedgkt_smoke():
     assert np.isfinite(out["Train/Acc"])
 
 
+@pytest.mark.slow  # compile/compute-heavy on the single-core CI box; core logic covered by faster siblings
 def test_main_fednas_smoke():
     from fedml_tpu.exp.main_fednas import main
 

@@ -423,9 +423,10 @@ def test_shard_rules_guards():
             aggregator=gossip_aggregator(ring_topology(4)))
 
 
+@pytest.mark.slow  # ~60s soak: both smoke arms recompile full Transformer round programs; the sharded-vs-unsharded bit-identity they assert stays tier-1 via test_fsdp_sharded_round_bit_identical / test_tp_sharded_round_allclose
 def test_shard_smoke_tool_runs():
-    """tools/shard_smoke.py is the tier-1 guard the docs point at — run it
-    in-process so the suite exercises exactly what it asserts."""
+    """tools/shard_smoke.py is the standalone guard the docs point at — run
+    it in-process so the suite exercises exactly what it asserts."""
     import importlib.util
     from pathlib import Path
 

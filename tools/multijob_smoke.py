@@ -1,0 +1,121 @@
+"""Multi-tenant compatibility smoke: the job-less path through the
+multi-job runner is the single-job harness, bit for bit.
+
+One default job (``job_id=None``) runs through ``run_multi_job`` — shared
+endpoint, router demux, fair fan-out scheduler and all — against the same
+trainer/data/seed through plain ``run_distributed_fedavg`` on its own
+fabric. Asserts (docs/MULTITENANCY.md "The default job"):
+
+- every round's global model and the final variables are byte-identical
+  (arrival order pinned by ordered uplink fabrics on both arms);
+- the default job stamps NO job-id header: every message crossing the
+  shared wire is a legal single-job message (zero wire-bytes change).
+
+    JAX_PLATFORMS=cpu python tools/multijob_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 3
+WORKERS = 4
+
+
+def main(argv=None) -> int:
+    import jax
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.tenancy import (
+        DEFAULT_JOB,
+        JobSpec,
+        MultiJobOrderedUplinkFabric,
+        run_multi_job,
+    )
+
+    train, _ = gaussian_blobs(
+        n_clients=WORKERS, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+
+    def leaves(v):
+        return [np.asarray(leaf).copy() for leaf in jax.tree.leaves(v)]
+
+    # -- solo arm: the single-job harness on its own ordered fabric --------
+    solo_rounds: list[tuple[int, list]] = []
+    solo_fabric = OrderedUplinkFabric(
+        WORKERS + 1, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+    )
+    solo_final = run_distributed_fedavg(
+        trainer, train, worker_num=WORKERS, round_num=ROUNDS, batch_size=8,
+        make_comm=lambda r: LoopbackCommManager(solo_fabric, r),
+        on_round_done=lambda r, v: solo_rounds.append((r, leaves(v))),
+    )
+
+    class HeaderAuditFabric(MultiJobOrderedUplinkFabric):
+        """Asserts the job-less contract ON the wire: no message of the
+        default job may carry the job-id header."""
+
+        def post(self, msg: Message) -> None:
+            assert msg.get(Message.MSG_ARG_KEY_JOB_ID) is None, (
+                f"default job stamped a job id header on msg type "
+                f"{msg.get_type()} — the job-less wire format must be "
+                "byte-identical to a single-job run's"
+            )
+            super().post(msg)
+
+    # -- multi arm: ONE default job through the full multi-tenant plane ----
+    multi_rounds: list[tuple[int, list]] = []
+    multi_fabric = HeaderAuditFabric(
+        WORKERS + 1, {DEFAULT_JOB: WORKERS},
+        MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+    )
+    results = run_multi_job(
+        [JobSpec(trainer=trainer, train_data=train, worker_num=WORKERS,
+                 round_num=ROUNDS, batch_size=8,
+                 on_round=lambda r, v: multi_rounds.append((r, leaves(v))))],
+        fabric=multi_fabric, join_timeout=300,
+    )
+    res = results[DEFAULT_JOB]
+    assert res.ok, f"default job failed through the runner: {res.error!r}"
+
+    # -- bit-identity: every round and the final model ---------------------
+    assert len(solo_rounds) == len(multi_rounds) == ROUNDS
+    for (rs, solo_leaves), (rm, multi_leaves) in zip(solo_rounds, multi_rounds):
+        assert rs == rm
+        for a, b in zip(solo_leaves, multi_leaves):
+            np.testing.assert_array_equal(
+                a, b,
+                err_msg=f"round {rs}: run_multi_job default job diverged "
+                        "from run_distributed_fedavg",
+            )
+    for a, b in zip(jax.tree.leaves(solo_final), jax.tree.leaves(res.final)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    print(
+        f"multijob smoke OK: {ROUNDS} rounds x {WORKERS} workers — default "
+        "job through the shared plane == single-job harness bit-for-bit, "
+        "no job-id header on the wire"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
