@@ -1156,6 +1156,145 @@ def bench_shard_ab(peak_tflops, fallback_reason):
     return out
 
 
+PACK_SHARD_LANES = 8  # lanes for the pack x shard A/B
+
+
+def _pack_shard_arms(n_rounds: int = 2):
+    """Three-arm rounds/sec for packed lanes composed with sharded plans
+    (docs/PERFORMANCE.md "Packed lanes on sharded plans") on a Zipf-256
+    TransformerLM cohort — the paper's non-IID shape, where the padded
+    layout scans 256 x head-client steps and masks most of them:
+
+    - packed x sharded: ``pack_lanes`` on a (2, model) fsdp mesh
+    - packed x unsharded: the same lanes on a 2-device client mesh
+      (isolates what the model axis costs the packed program)
+    - padded x sharded: the same fsdp mesh without lanes (isolates what
+      packing buys once the plan is sharded)
+
+    Both attention arms stay on the xla path for symmetry (the flash
+    kernel's per-rank shard_map wrap is exercised by the smoke and the TP
+    tests; mixing it into one arm only would skew the A/B). Runs under
+    whatever devices are present — the caller labels CPU-fallback runs.
+    Returns a dict of probe metrics."""
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.models.transformer import TransformerLM
+    from fedml_tpu.parallel.mesh import client_mesh
+    from fedml_tpu.sim.cohort import FederatedArrays
+    from fedml_tpu.sim.engine import FedSim, SimConfig
+
+    # the persistent compile cache, configured here too because the CPU
+    # fallback runs this function in a bare subprocess that never passes
+    # through _main's cache setup
+    jax.config.update("jax_compilation_cache_dir",
+                      os.environ.get("FEDML_TPU_JAX_CACHE",
+                                     str(Path(__file__).parent / ".jax_cache")))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    if n_dev < 4:
+        return {"pack_shard_skipped":
+                f"needs >= 4 devices for a (2, n) mesh, have {n_dev}"}
+    # XLA:CPU's SPMD partitioner chokes on wide model axes x lane vmaps
+    # (a (2, 4) virtual mesh at 16 lanes never finished compiling); the
+    # CPU arm keeps a 2-way model axis, real chips take the whole mesh
+    model_ranks = n_dev // 2 if devices[0].platform == "tpu" else 2
+    mesh_shape = (2, model_ranks)
+
+    C, B, V, T, D, H, L = PACK_CLIENTS, 16, 64, 16, 32, 2, 2
+    sizes = np.maximum((256 / np.arange(1, C + 1) ** 1.1), 1).astype(int)
+    rng = np.random.RandomState(0)
+    n = int(sizes.sum())
+    x = rng.randint(0, V, (n, T)).astype(np.int32)
+    y = rng.randint(0, V, (n, T)).astype(np.int32)
+    mask = np.ones((n, T), np.float32)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    part = {i: np.arange(bounds[i], bounds[i + 1]) for i in range(C)}
+    train = FederatedArrays({"x": x, "y": y, "mask": mask}, part)
+    trainer = ClientTrainer(
+        module=TransformerLM(vocab_size=V, embed_dim=D, num_layers=L,
+                             num_heads=H, max_len=T, attn_impl="xla"),
+        task="nwp",
+        optimizer=optax.sgd(0.1), epochs=1,
+    )
+    cfg = SimConfig(
+        client_num_in_total=C, client_num_per_round=C, batch_size=B,
+        comm_round=n_rounds, epochs=1, frequency_of_the_test=10_000,
+        shuffle_each_round=False, seed=0, block_dispatch=False,
+    )
+
+    def rps(c, mesh=None):
+        sim = FedSim(trainer, train, None, c, mesh=mesh)
+        sim.run()  # compile + warm
+        t0 = time.perf_counter()
+        _, hist = sim.run()
+        return len(hist) / (time.perf_counter() - t0), sim
+
+    shard_cfg = dataclasses.replace(
+        cfg, mesh_shape=mesh_shape, shard_rules="transformer_fsdp")
+    ps_rps, ps_sim = rps(dataclasses.replace(
+        shard_cfg, pack_lanes=PACK_SHARD_LANES))
+    pu_rps, _ = rps(dataclasses.replace(cfg, pack_lanes=PACK_SHARD_LANES),
+                    mesh=client_mesh(devices[:2]))
+    pad_rps, _ = rps(shard_cfg)
+    stats = ps_sim.pack_round_stats(0)
+    return {
+        "pack_shard_mesh": list(mesh_shape),
+        "pack_shard_rules": "transformer_fsdp",
+        "pack_shard_zipf_clients": C,
+        "pack_shard_lanes": PACK_SHARD_LANES,
+        "pack_shard_rounds_per_sec": round(ps_rps, 3),
+        "pack_unsharded_rounds_per_sec": round(pu_rps, 3),
+        "padded_shard_rounds_per_sec": round(pad_rps, 3),
+        "pack_shard_speedup_vs_padded": round(ps_rps / pad_rps, 2),
+        "pack_shard_n_passes": stats["n_passes"],
+    }
+
+
+def bench_pack_shard_ab(fallback_reason):
+    """Packed-lanes-on-sharded-plans A/B. On the intended accelerator the
+    three arms run in-process on the real mesh. On CPU fallback the same
+    arms run in a subprocess on 8 virtual host devices — labeled
+    ``pack_shard_cpu_fallback`` so the reduced-shape CPU figures can never
+    be read as a perf trajectory (the figure that matters there is the
+    RELATIVE pack-vs-padded ratio on a sharded plan, which is shape-bound,
+    not platform-bound)."""
+    import json as _json
+    import subprocess
+
+    if fallback_reason is None:
+        return _pack_shard_arms()
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import json, bench; print(json.dumps(bench._pack_shard_arms()))"],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=str(Path(__file__).parent),
+    )
+    if out.returncode != 0:
+        tail = (out.stderr or out.stdout).strip().splitlines()
+        return {"pack_shard_error": tail[-1] if tail else
+                f"pack_shard arms rc={out.returncode}"}
+    parsed = {}
+    for line in out.stdout.splitlines():
+        if line.startswith("{"):
+            parsed = _json.loads(line)
+    return {"pack_shard_cpu_fallback": True, **parsed}
+
+
 def bench_resnet(reduced: bool = False):
     """(rounds/sec, eval examples/sec, pipeline extras) for the primary
     ResNet-56 config.
@@ -1487,7 +1626,7 @@ def _main(stage: list):
 
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("FEDML_TPU_JAX_CACHE",
-                                     "/tmp/fedml_tpu_jax_cache"))
+                                     str(Path(__file__).parent / ".jax_cache")))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     peak = PEAK_TFLOPS.get(device_kind)
     if fallback_reason is not None:
@@ -1590,6 +1729,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_shard_ab(peak, fallback_reason))
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["shard_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_pack_shard_probe"
+    try:
+        pipeline_extra.update(bench_pack_shard_ab(fallback_reason))
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["pack_shard_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_stage_probe"
     try:

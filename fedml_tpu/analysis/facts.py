@@ -38,7 +38,7 @@ _KEY_RE = re.compile(r"^MSG_ARG_KEY_\w+$")
 # schema version of the serialized facts: bump on ANY change to the
 # dataclasses below or to extraction semantics — the cache discards
 # mismatched entries wholesale
-FACTS_SCHEMA_VERSION = 1
+FACTS_SCHEMA_VERSION = 2
 
 # call names that register their callable arguments as THREAD ENTRIES:
 # the callable runs later on another thread, with no locks held
@@ -636,6 +636,13 @@ class _Extractor(ast.NodeVisitor):
             arg0 = node.args[0]
             if isinstance(arg0, ast.Name):
                 self.facts.lowered_names.append((arg0.id, via))
+            elif isinstance(arg0, ast.Attribute):
+                # method handles lowered by reference — the engine's packed/
+                # sharded program constructors pass bound methods to
+                # dispatch.lower (``displib.lower(self._packed_agg_impl,
+                # ...)``); record the terminal attr so traced-purity scans
+                # the method body like any lowered function
+                self.facts.lowered_names.append((arg0.attr, via))
             elif isinstance(arg0, ast.Lambda):
                 self._lambda_via[id(arg0)] = via
 

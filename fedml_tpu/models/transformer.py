@@ -22,7 +22,11 @@ from __future__ import annotations
 import flax.linen as nn
 import jax.numpy as jnp
 
-from fedml_tpu.ops.attention import attention_reference, flash_attention
+from fedml_tpu.ops.attention import (
+    attention_reference,
+    flash_attention,
+    flash_attention_head_parallel,
+)
 from fedml_tpu.parallel.ring_attention import ring_attention
 
 
@@ -36,10 +40,12 @@ class MultiHeadSelfAttention(nn.Module):
     # models"): when set, head-axis sharding constraints pin q/k/v to the
     # tensor-parallel layout the partition rules put on the qkv kernel, so
     # each model shard attends over its own heads. Requires tracing under
-    # the plan's mesh (parallel/dispatch.py provides the context). Note:
-    # GSPMD partitions the xla attention path by heads; the pallas flash
-    # kernel is an opaque custom call to the partitioner and runs on
-    # gathered heads unless wrapped in shard_map.
+    # the plan's mesh (parallel/dispatch.py provides the context). GSPMD
+    # partitions the xla attention path by heads on its own; the pallas
+    # flash kernel is an opaque custom call to the partitioner, so the
+    # flash path routes through ops.attention.flash_attention_head_parallel
+    # (a per-rank shard_map over this axis, with a gathered-xla fallback
+    # when heads don't divide it).
     mp_axis: str | None = None
     # flash kernel tile sizes, tuned on a v5e at T=1024, D_head=128: a tall
     # 256-row query block with the whole 1024-key sequence in one block beat
@@ -66,8 +72,12 @@ class MultiHeadSelfAttention(nn.Module):
             k = constrain(k, hspec)
             v = constrain(v, hspec)
         if self.attn_impl == "flash":
-            o = flash_attention(q, k, v, causal=True,
-                                block_q=self.block_q, block_k=self.block_k)
+            # head-parallel under a TP plan (mp_axis set + active mesh):
+            # each model rank runs the pallas kernel on its local heads;
+            # plain kernel otherwise — see flash_attention_head_parallel
+            o = flash_attention_head_parallel(
+                q, k, v, axis=self.mp_axis, causal=True,
+                block_q=self.block_q, block_k=self.block_k)
         elif self.attn_impl == "ring":
             o = ring_attention(q, k, v, axis_name=self.sp_axis, causal=True)
         else:

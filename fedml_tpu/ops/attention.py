@@ -227,6 +227,74 @@ def flash_attention(
     return _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret)
 
 
+def flash_attention_head_parallel(
+    q,
+    k,
+    v,
+    *,
+    axis: str | None,
+    causal: bool = False,
+    sm_scale: float | None = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    """:func:`flash_attention` on a tensor-parallel sharded plan: each
+    ``axis`` rank runs the pallas kernel on its LOCAL heads.
+
+    The pallas kernel is an opaque custom call to the XLA SPMD partitioner,
+    so under a sharded plan the unwrapped kernel forces a gather to full
+    heads per device — the exact memory blow-up the plan exists to avoid.
+    Wrapping it in a head-parallel ``shard_map`` over the model axis keeps
+    the ``[B, H_local, T, D]`` blocks resident: attention is head-local math
+    (softmax normalizes per head), so the per-rank kernel computes bits
+    identical to the full-head kernel's.
+
+    Resolution order at trace time:
+
+    - no ``axis``, no active mesh, ``axis`` not on the mesh, or a 1-way
+      axis → the plain kernel (unsharded behavior, bit-identical);
+    - heads divide the axis → per-rank kernel under ``compat.shard_map``;
+    - heads do NOT divide the axis → :func:`attention_reference` (plain XLA
+      — the partitioner can split *its* einsums head-wise) with a loud
+      warning, because silently gathering the kernel would defeat the plan.
+    """
+    from fedml_tpu.parallel import compat
+
+    mesh = compat.current_mesh()
+    if (
+        axis is None
+        or mesh is None
+        or axis not in mesh.axis_names
+        or mesh.shape[axis] == 1
+    ):
+        return flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
+    n_ranks = int(mesh.shape[axis])
+    n_heads = q.shape[1]
+    if n_heads % n_ranks:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "flash attention under a %d-way %r model axis: %d heads do not "
+            "divide the axis, so the pallas kernel cannot run per-rank — "
+            "falling back to gathered xla attention for this program; pick "
+            "num_heads divisible by the model axis to keep the kernel on "
+            "the sharded path",
+            n_ranks, axis, n_heads,
+        )
+        return attention_reference(q, k, v, causal=causal, sm_scale=sm_scale)
+    from jax.sharding import PartitionSpec
+
+    hspec = PartitionSpec(None, axis, None, None)
+    return compat.shard_map(
+        functools.partial(
+            flash_attention, causal=causal, sm_scale=sm_scale,
+            block_q=block_q, block_k=block_k,
+        ),
+        mesh=mesh, in_specs=(hspec,) * 3, out_specs=hspec,
+        axis_names={axis}, check_vma=False,
+    )(q, k, v)
+
+
 def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
     out = flash_attention(q, k, v, causal, sm_scale, block_q, block_k)
     return out, (q, k, v, out)

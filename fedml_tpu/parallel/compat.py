@@ -16,6 +16,31 @@ from typing import Any
 import jax
 
 
+def current_mesh():
+    """The :class:`jax.sharding.Mesh` of the innermost active mesh context,
+    or ``None`` when no mesh is active.
+
+    This is how a traced op discovers the mesh the surrounding program is
+    being lowered under (``parallel/dispatch.py`` enters the mesh context
+    around every pjit trace) — e.g. the head-parallel flash wrap in
+    ``ops/attention.py`` decides at trace time whether to nest a per-rank
+    ``shard_map`` over the model axis. The thread-local lives in different
+    homes across jax versions; probe them in order.
+    """
+    try:
+        from jax.interpreters import pxla
+
+        m = pxla.thread_resources.env.physical_mesh
+    except AttributeError:
+        try:
+            from jax._src import mesh as mesh_lib
+
+            m = mesh_lib.thread_resources.env.physical_mesh
+        except (ImportError, AttributeError):
+            return None
+    return None if m is None or m.empty else m
+
+
 def shard_map(f, *, mesh, in_specs, out_specs,
               axis_names: Any = None, check_vma: bool | None = None):
     """``jax.shard_map`` signature, runnable on old and new jax alike.

@@ -115,6 +115,13 @@ def lower(
     honored on both paths (on pjit via jit's native donation; on
     shard_map via the jit wrapper exactly as the engine built by hand
     before this dispatcher existed).
+
+    Every round program constructor routes through here — the padded
+    pass/aggregate pair AND the packed-lane trio (buffer init, lane pass,
+    aggregate; ``sim/engine.py`` ``_packed_*_impl``) — so packed cohorts
+    are served by whichever lowering the specs pick: pjit plans when
+    ``shard_rules`` shards the model, the shard_map fallback otherwise
+    (docs/PERFORMANCE.md "Packed lanes on sharded plans").
     """
     if plan_is_sharded(in_specs, out_specs, mapped_axes=mapped_axes):
         jitted = jax.jit(

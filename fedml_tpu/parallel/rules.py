@@ -34,6 +34,13 @@ Built-in rule sets (:func:`rule_set`) cover the model zoo's two families:
   models' own batch-statistic reductions fuse differently across the two
   programs and match the unsharded round to ~1 ULP, not bitwise
   (measured: 16/287 ResNet-56 leaves, all ``batch_stats/*/mean``).
+
+Rules are COHORT-LAYOUT-AGNOSTIC: a spec names only model axes, never the
+``clients`` axis, so the same rule set serves the padded cohort vmap and
+the packed-lane programs unchanged — the engine supplies the client-axis
+dimension (cohort slots or lanes) outside the spec, and the planner's
+per-shard lane binning never consults the rules (docs/PERFORMANCE.md
+"Packed lanes on sharded plans").
 """
 
 from __future__ import annotations

@@ -379,7 +379,11 @@ def pack_cohort(
     device's lanes only ever emit into its own update-stack block and the
     packed program combines shards with the exact same ``all_gather`` the
     padded program uses — no cross-device scatter arithmetic to perturb
-    bit-identity. Within a shard: longest-processing-time order, each client
+    bit-identity. The same per-shard blocks serve BOTH lowerings of the
+    packed programs: the manual shard_map path indexes its block by
+    ``axis_index``, and the pjit global-view path lets GSPMD shard the
+    lane dimension on the clients axis — the plan is layout-agnostic
+    (docs/PERFORMANCE.md "Packed lanes on sharded plans"). Within a shard: longest-processing-time order, each client
     onto the least-loaded lane that still fits; clients that fit no lane of
     the current pass spill to a fresh pass (same shapes, extra sequential
     dispatch). Pure numpy, O(total executed steps) like the CSR staging

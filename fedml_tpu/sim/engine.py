@@ -441,13 +441,6 @@ class FedSim:
                     "gossip) keep a model per client and need the "
                     "unsharded path"
                 )
-            if config.pack_lanes > 0:
-                raise NotImplementedError(
-                    "pack_lanes with shard_rules is not wired yet: packed "
-                    "lanes run on the client-mapped shard_map programs, "
-                    "sharded models on the pjit programs — run sharded "
-                    "rounds on the padded path"
-                )
             if config.block_dispatch:
                 raise ValueError(
                     "block_dispatch scans whole rounds inside one program "
@@ -455,11 +448,12 @@ class FedSim:
                     "dispatch boundary; leave block_dispatch off with "
                     "shard_rules"
                 )
-            if jax.process_count() > 1:
-                raise NotImplementedError(
-                    "shard_rules on a multi-controller (jax.distributed) "
-                    "mesh is not wired yet"
-                )
+            # multi-controller (jax.distributed) meshes are supported: the
+            # (hosts x clients x model) device grid comes from shard_mesh's
+            # global jax.devices() order, pjit programs run global-view, and
+            # the jax.process_count()>1 capability check below routes model
+            # staging through stage_global (each process materializes only
+            # its addressable shards of the rule-placed layout)
             ruleset = ruleslib.rule_set(config.shard_rules)
             self._shard_gather = ruleset.gather_compute
             if ruleset.act_spec is not None and hasattr(
@@ -538,28 +532,41 @@ class FedSim:
             )
         self._pack = config.pack_lanes > 0
         if self._pack:
+            # One error per conflict, each leading with the SimConfig field
+            # (or constructor argument) that has to change — a config with
+            # several conflicts reports the first, fixes it, and gets the
+            # next precise message instead of one undifferentiated blob.
             if self._per_client:
                 raise ValueError(
-                    "pack_lanes resets lane carries to the BROADCAST global "
-                    "params at client boundaries; per-client aggregators "
-                    "(decentralized/gossip) need the padded path"
+                    f"aggregator={self.aggregator.name!r} (per-client) "
+                    f"conflicts with pack_lanes={config.pack_lanes}: packed "
+                    "lanes reset carries to the BROADCAST global params at "
+                    "client boundaries, but per-client aggregators (decentralized/"
+                    "gossip) keep a model per client — use the padded path "
+                    "(pack_lanes=0)"
                 )
             if config.cohort_execution == "scan":
                 raise ValueError(
-                    "pack_lanes replaces the cohort execution loop entirely; "
-                    "leave cohort_execution='vmap' (lanes are vmapped)"
+                    "SimConfig.cohort_execution='scan' conflicts with "
+                    f"pack_lanes={config.pack_lanes}: packed lanes replace "
+                    "the cohort execution loop entirely — leave "
+                    "cohort_execution='vmap' (lanes are vmapped)"
                 )
             if local_train_fn is not None:
                 raise ValueError(
-                    "pack_lanes drives ClientTrainer.train_step directly "
-                    "(boundary-aware lane steps) and cannot honor a custom "
-                    "local_train_fn (e.g. the GAN adversarial loop); use the "
-                    "padded path for custom round programs"
+                    "local_train_fn conflicts with pack_lanes="
+                    f"{config.pack_lanes}: packed lanes drive "
+                    "ClientTrainer.train_step directly (boundary-aware lane "
+                    "steps) and cannot honor a custom round program (e.g. "
+                    "the GAN adversarial loop) — use the padded path "
+                    "(pack_lanes=0)"
                 )
             if config.block_dispatch:
                 raise ValueError(
-                    "pack_lanes and block_dispatch are mutually exclusive: "
-                    "packed rounds already dispatch one program per pass"
+                    "SimConfig.block_dispatch=True conflicts with "
+                    f"pack_lanes={config.pack_lanes}: packed rounds already "
+                    "dispatch one program per pass — leave block_dispatch "
+                    "off (or unset) with pack_lanes"
                 )
             n_dev = self._n_client_shards
             self._c_pad = -(-config.client_num_per_round // n_dev) * n_dev
@@ -712,39 +719,101 @@ class FedSim:
             from fedml_tpu.core.trainer import make_lane_step
 
             self._lane_step = make_lane_step(trainer)
-            self._packed_buf_fn = displib.lower(
-                self._packed_buf_impl, mesh=self.mesh,
-                in_specs=(P(),),
-                out_specs=(cohort_spec,) * 4,
-            )
-            if self._on_device:
-                pass_impl = self._packed_gather_pass_impl
-                pass_specs = (P(), P()) + (cohort_spec,) * 8 + (P(),)
-                buf_args = (6, 7, 8, 9)  # (stack, written, lbuf, wbuf)
+            if self._spmd:
+                # Packed lanes on a sharded plan (docs/PERFORMANCE.md
+                # "Packed lanes on sharded plans"): the same three-program
+                # family in GLOBAL view. Lane layout is client-axis-only —
+                # the planner still bins each shard's clients into that
+                # shard's lane block, so PackPass gather maps never touch
+                # the model axes — while GSPMD partitions the model per the
+                # rule plan inside every lane step. The update stack crosses
+                # the pass->aggregate boundary at the plan's stack layout
+                # (replicated for gather_compute exactness, sharded for TP
+                # memory), exactly like the padded sharded round above.
+                lane_spec = cohort_spec  # lanes ride the clients axis
+                # The round buffers (written mask + loss/weight scatter
+                # buffers) follow the STACK's boundary layout, not the lane
+                # layout: under gather plans they must arrive replicated at
+                # the aggregate program, or GSPMD shards the rebuilt
+                # per-client stack over clients and PARTITIONS the
+                # aggregator's reduce — a cross-shard partial-sum
+                # reassociation that breaks the gather plan's bit-identity
+                # contract (measured: 1 ULP). TP plans keep them
+                # lane-sharded (their reduce is partitioned anyway — the
+                # documented ~1 ULP TP caveat).
+                buf_spec = P() if self._shard_gather else lane_spec
+                bufs_specs = (self._stack_spec,) + (buf_spec,) * 3
+                self._packed_buf_fn = displib.lower(
+                    self._packed_buf_impl, mesh=self.mesh,
+                    in_specs=(self._var_specs,),
+                    out_specs=bufs_specs,
+                )
+                if self._on_device:
+                    pass_impl = self._packed_gather_pass_impl
+                    pass_specs = (
+                        (self._var_specs, P()) + (lane_spec,) * 4
+                        + bufs_specs + (P(),)
+                    )
+                    buf_args = (6, 7, 8, 9)  # (stack, written, lbuf, wbuf)
+                else:
+                    pass_impl = self._packed_host_pass_impl
+                    pass_specs = (
+                        (self._var_specs,) + (lane_spec,) * 4
+                        + bufs_specs + (P(),)
+                    )
+                    buf_args = (5, 6, 7, 8)
+                # pjit programs gate donation on the backend implementing
+                # it, like agg_donate above (the legacy shard_map lowering
+                # bug does not apply to pjit)
+                pjit_donate = jax.default_backend() != "cpu"
+                self._packed_pass_fn = displib.lower(
+                    pass_impl, mesh=self.mesh,
+                    in_specs=pass_specs,
+                    out_specs=bufs_specs,
+                    donate_argnums=buf_args if pjit_donate else (),
+                )
+                self._packed_agg_fn = displib.lower(
+                    self._packed_agg_impl, mesh=self.mesh,
+                    in_specs=(self._var_specs, P()) + bufs_specs
+                    + (P(), P(), P()),
+                    out_specs=(self._var_specs, P(), P()),
+                    donate_argnums=(2, 3, 4, 5) if pjit_donate else (),
+                )
             else:
-                pass_impl = self._packed_host_pass_impl
-                pass_specs = (P(),) + (cohort_spec,) * 8 + (P(),)
-                buf_args = (5, 6, 7, 8)
-            # The chained round buffers are exclusively owned (built by the
-            # buf program, consumed once per pass, then by the aggregation) —
-            # donate them so passes update the stack in place instead of
-            # holding two [C_pad, model] copies live. Same legacy-lowering
-            # guard as self._donate (see the donation note above).
-            buf_donate = buf_args if hasattr(jax, "shard_map") else ()
-            self._packed_pass_fn = displib.lower(
-                pass_impl, mesh=self.mesh,
-                in_specs=pass_specs,
-                out_specs=(cohort_spec,) * 4,
-                donate_argnums=buf_donate,
-            )
-            self._packed_agg_fn = displib.lower(
-                self._packed_agg_impl, mesh=self.mesh,
-                in_specs=(P(), P()) + (cohort_spec,) * 6 + (P(),),
-                out_specs=(P(), P(), P()),
-                donate_argnums=(
-                    (2, 3, 4, 5) if hasattr(jax, "shard_map") else ()
-                ),
-            )
+                self._packed_buf_fn = displib.lower(
+                    self._packed_buf_impl, mesh=self.mesh,
+                    in_specs=(P(),),
+                    out_specs=(cohort_spec,) * 4,
+                )
+                if self._on_device:
+                    pass_impl = self._packed_gather_pass_impl
+                    pass_specs = (P(), P()) + (cohort_spec,) * 8 + (P(),)
+                    buf_args = (6, 7, 8, 9)  # (stack, written, lbuf, wbuf)
+                else:
+                    pass_impl = self._packed_host_pass_impl
+                    pass_specs = (P(),) + (cohort_spec,) * 8 + (P(),)
+                    buf_args = (5, 6, 7, 8)
+                # The chained round buffers are exclusively owned (built by
+                # the buf program, consumed once per pass, then by the
+                # aggregation) — donate them so passes update the stack in
+                # place instead of holding two [C_pad, model] copies live.
+                # Same legacy-lowering guard as self._donate (see the
+                # donation note above).
+                buf_donate = buf_args if hasattr(jax, "shard_map") else ()
+                self._packed_pass_fn = displib.lower(
+                    pass_impl, mesh=self.mesh,
+                    in_specs=pass_specs,
+                    out_specs=(cohort_spec,) * 4,
+                    donate_argnums=buf_donate,
+                )
+                self._packed_agg_fn = displib.lower(
+                    self._packed_agg_impl, mesh=self.mesh,
+                    in_specs=(P(), P()) + (cohort_spec,) * 6 + (P(),),
+                    out_specs=(P(), P(), P()),
+                    donate_argnums=(
+                        (2, 3, 4, 5) if hasattr(jax, "shard_map") else ()
+                    ),
+                )
 
         self._test_batches = None
         if test_arrays is not None and self._can_eval:
@@ -1016,7 +1085,12 @@ class FedSim:
         # Per-shard zero output buffers for one packed round: the update
         # stack [c_local, ...], its written mask, and the per-(client, chain
         # step) loss/weight scatter buffers the metrics are rebuilt from.
-        c_local = self._c_pad // self._n_client_shards
+        # Under a shard plan the program is global-view pjit, so the buffers
+        # span the whole cohort and GSPMD lays them out per the out specs.
+        c_local = (
+            self._c_pad if self._spmd
+            else self._c_pad // self._n_client_shards
+        )
         T = self.trainer.epochs * self._steps
         stack = jax.tree.map(
             lambda l: jnp.zeros((c_local,) + l.shape, l.dtype), variables
@@ -1038,8 +1112,17 @@ class FedSim:
         T = self.trainer.epochs * self._steps
         c_local = written.shape[0]
         l_local = slot.shape[0]
-        shard_idx = jax.lax.axis_index(CLIENT_AXIS)
-        base = shard_idx * c_local
+        if self._spmd:
+            # global-view pjit: every slot is visible, so the slot ids ARE
+            # the global ids — identical rng chains to the manual program's
+            # axis_index-derived fold_ins. The model arrives in the plan's
+            # at-rest layout; pin it to the compute view (replicated under
+            # gather plans — bit-exact concat — identity under TP).
+            variables = self._compute_view(variables)
+            base = 0
+        else:
+            shard_idx = jax.lax.axis_index(CLIENT_AXIS)
+            base = shard_idx * c_local
         slot_ids = base + jnp.arange(c_local)
         # The EXACT per-client rng chains the padded scan walks: fold_in by
         # global slot, then one split per epochs-x-steps scan step. Skipped
@@ -1056,7 +1139,13 @@ class FedSim:
 
         keys_full = jax.vmap(chain)(keys0)  # [c_local, T] step keys
         opt0 = self.trainer.optimizer.init(variables["params"])
-        vstep = jax.vmap(self._lane_step, in_axes=(0, 0, None, None, 0, 0, 0))
+        # under a shard plan the lane axis IS the mesh's client axis (lanes
+        # are binned per client shard), so name it for GSPMD like the padded
+        # sharded round's cohort vmap
+        vstep = jax.vmap(
+            self._lane_step, in_axes=(0, 0, None, None, 0, 0, 0),
+            **({"spmd_axis_name": CLIENT_AXIS} if self._spmd else {}),
+        )
         broadcast = lambda tree: jax.tree.map(  # noqa: E731
             lambda l: jnp.broadcast_to(
                 jnp.asarray(l)[None], (l_local,) + jnp.shape(l)
@@ -1125,6 +1214,7 @@ class FedSim:
         # pass buffers, then run the shared aggregation tail. Unwritten slots
         # (zero-weight cohort padding) select the global variables — the same
         # bits the padded path's fully-masked scan leaves there.
+        variables = self._compute_view(variables)
         E, S = self.trainer.epochs, self._steps
         c_local = weights.shape[0]
         local_vars = jax.tree.map(
@@ -1134,6 +1224,9 @@ class FedSim:
             stack, variables,
         )
         # The padded program's per-epoch loss sum is `jnp.sum(losses * ws)`
+        # (under a shard plan, `variables` was pinned to the compute view
+        # above, so the unwritten-slot fallback bits match the padded
+        # sharded program's masked-scan leftovers exactly)
         # over the step scan's ys — and its SUMMATION ORDER depends on how
         # that scan lowered: straight-lined (scanlib's CPU mode) the stack
         # of per-step scalars fuses into a left-to-right add chain; rolled,
@@ -1339,8 +1432,17 @@ class FedSim:
             v = graft_params(jax.tree.map(np.asarray, dict(v)), dict(overrides))
         if not self._per_client:
             if self._spmd:
-                # sharded-at-rest layout: each leaf placed per its
-                # partition rule (multihost is excluded at construction)
+                if self._multihost:
+                    # multi-controller capability path: every process holds
+                    # the same host init; stage_global materializes only the
+                    # addressable shards of each leaf's rule placement
+                    from fedml_tpu.parallel.multihost import stage_global
+
+                    return jax.tree.map(
+                        lambda leaf, sh: stage_global(np.asarray(leaf), sh),
+                        v, self._var_shardings,
+                    )
+                # sharded-at-rest layout: each leaf placed per its rule
                 return jax.device_put(v, self._var_shardings)
             return self._put(v, self._rep)
         n_dev = self.mesh.shape[meshlib.CLIENT_AXIS]
@@ -1631,6 +1733,10 @@ class FedSim:
         trace.counter("engine/overflow_passes", len(plan.passes) - 1,
                       round=round_idx)
         lane_shard = meshlib.client_sharded(self.mesh)
+        # sharded (pjit) packed rounds take the tiny [C_pad] cohort vectors
+        # replicated, matching the aggregate program's in specs (same
+        # contract as stage_cohort's scalar_sharding)
+        scalar_sharding = self._rep if self._spmd else lane_shard
         passes = []
         for pp in plan.passes:
             pidx = cohortlib.pack_index_map(idx, pp)
@@ -1649,8 +1755,8 @@ class FedSim:
             ))
         return PackedStaged(
             passes=tuple(passes),
-            weights=self._put(weights, lane_shard),
-            num_steps=self._put(num_steps, lane_shard),
+            weights=self._put(weights, scalar_sharding),
+            num_steps=self._put(num_steps, scalar_sharding),
             rkey=rkey,
             stats={
                 "n_passes": len(plan.passes),
@@ -1683,10 +1789,13 @@ class FedSim:
             # enqueue asynchronously, so the split costs no host sync.
             # Normalize caller-held layouts first (a checkpoint restore or
             # a fresh aggregator state may arrive in another sharding;
-            # device_put short-circuits when it already matches).
-            global_variables = jax.device_put(
-                global_variables, self._var_shardings)
-            server_state = jax.device_put(server_state, self._rep)
+            # device_put short-circuits when it already matches). Multihost
+            # runs skip this: cross-process resharding is not a device_put,
+            # and init_round_variables already places the model globally.
+            if not self._multihost:
+                global_variables = jax.device_put(
+                    global_variables, self._var_shardings)
+                server_state = jax.device_put(server_state, self._rep)
             with trace.span("engine/dispatch", program="spmd_train",
                             first=self._first_dispatch("spmd_train")):
                 if self._on_device:
@@ -1721,6 +1830,14 @@ class FedSim:
         """One packed round: zero buffers, P lane-scan passes chaining the
         update stack, then the aggregation program. All dispatches enqueue
         asynchronously, so the extra program boundaries cost no host sync."""
+        if self._spmd and not self._multihost:
+            # sharded packed round: normalize caller-held layouts to the
+            # rule-placed at-rest layout, like run_staged_round's padded
+            # sharded branch (multihost callers stage through
+            # init_round_variables, which already places globally)
+            global_variables = jax.device_put(
+                global_variables, self._var_shardings)
+            server_state = jax.device_put(server_state, self._rep)
         bufs = self._packed_buf_fn(global_variables)
         for data, slot, gidx, boundary in staged.passes:
             if self._on_device:

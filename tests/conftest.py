@@ -17,10 +17,14 @@ jax.config.update("jax_platforms", "cpu")
 
 # Persistent XLA compilation cache: most of this suite's wall-clock is
 # XLA:CPU compilation of federated round programs, and many tests rebuild
-# the same program shapes. Warm runs skip those compiles entirely.
+# the same program shapes. Warm runs skip those compiles entirely. The
+# repo-local gitignored dir (not /tmp) survives container tmp-cleaners and
+# is shared with tools/shard_smoke.py standalone runs and bench.py, so the
+# in-process smoke arms in tier-1 hit programs those already compiled.
 jax.config.update("jax_compilation_cache_dir",
                   os.environ.get("FEDML_TPU_JAX_CACHE",
-                                 "/tmp/fedml_tpu_jax_cache"))
+                                 os.path.join(os.path.dirname(__file__),
+                                              "..", ".jax_cache")))
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402

@@ -263,17 +263,40 @@ def test_pack_index_map_gathers_padded_rows():
 
 
 def test_pack_lanes_config_validation():
+    # one error per conflict, each leading with the SimConfig field (or
+    # constructor argument) the user has to change
     train, test = _fixture(UNIFORM)
     base = dict(client_num_in_total=6, client_num_per_round=4, batch_size=8)
-    with pytest.raises(ValueError, match="cohort_execution"):
+    with pytest.raises(
+        ValueError,
+        match=r"SimConfig\.cohort_execution='scan' conflicts with pack_lanes=2",
+    ):
         FedSim(_trainer(), train, test,
                SimConfig(pack_lanes=2, cohort_execution="scan", **base))
-    with pytest.raises(ValueError, match="block_dispatch"):
+    with pytest.raises(
+        ValueError,
+        match=r"SimConfig\.block_dispatch=True conflicts with pack_lanes=2",
+    ):
         FedSim(_trainer(), train, test,
                SimConfig(pack_lanes=2, block_dispatch=True, **base))
-    with pytest.raises(ValueError, match="local_train_fn"):
+    with pytest.raises(
+        ValueError, match=r"local_train_fn conflicts with pack_lanes=2",
+    ):
         FedSim(_trainer(), train, test, SimConfig(pack_lanes=2, **base),
                local_train_fn=lambda *a: None)
+
+    from fedml_tpu.algorithms.decentralized import gossip_aggregator
+    from fedml_tpu.topology.topology import ring_topology
+
+    with pytest.raises(
+        ValueError,
+        match=r"aggregator='.*' \(per-client\) conflicts with pack_lanes=2",
+    ):
+        # full participation: the per-client aggregator's own precondition
+        FedSim(_trainer(), train, test,
+               SimConfig(pack_lanes=2, client_num_in_total=6,
+                         client_num_per_round=6, batch_size=8),
+               aggregator=gossip_aggregator(ring_topology(6)))
 
 
 def test_pack_smoke_tool_runs():

@@ -320,6 +320,47 @@ def test_engine_packed_padded_bit_identity_under_churn():
                 assert rb[k] == v, (k, rb[k], v)
 
 
+def test_engine_packed_sharded_bit_identity_under_churn():
+    # predicted-step packing (population speed -> predicted budgets,
+    # dropout -> actual < predicted) composed with a sharded (2, 2) plan:
+    # bit-identical to the SAME packed program on an unsharded client mesh,
+    # and the sharded engine's own round plan still places every executed
+    # step exactly once (docs/PERFORMANCE.md "Packed lanes on sharded
+    # plans")
+    from fedml_tpu.parallel.mesh import client_mesh
+
+    trainer, train, test, cfg = _sim_fixture(population=CHURN)
+    cfg = dataclasses.replace(cfg, pack_lanes=2)
+    sim_s = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="cnn_fsdp"))
+    assert sim_s._pack and sim_s._spmd
+    v_s, h_s = sim_s.run()
+    v_u, h_u = FedSim(trainer, train, test, cfg,
+                      mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_bitwise(v_s, v_u)
+    for ru, rs in zip(h_u, h_s):
+        for k, v in ru.items():
+            if k != "round_time":
+                assert rs[k] == v, (k, rs[k], v)
+    # place-exactly-once on the plan the sharded engine actually builds:
+    # every executed step lands in one lane of one pass, one boundary per
+    # slot, nothing double-placed across client shards
+    _, _, _, plan = sim_s._pack_round_plan(sim_s._sample_round_cohort(0), 0)
+    seen: dict[int, list] = {}
+    for pi, pp in enumerate(plan.passes):
+        for li in range(pp.slot.shape[0]):
+            for pos in range(pp.slot.shape[1]):
+                s = int(pp.slot[li, pos])
+                if s >= 0:
+                    seen.setdefault(s, []).append(
+                        (pi, li, int(pp.boundary[li, pos]))
+                    )
+    assert sum(len(p) for p in seen.values()) == plan.total_steps
+    for s, places in seen.items():
+        assert len({(pi, li) for pi, li, _ in places}) == 1, s
+        assert sum(b for _, _, b in places) == 1, s
+
+
 def test_engine_dropout_excludes_weight():
     # dropout=1 with a tiny executed fraction: every member trains a stub
     # and nothing survives — the engine must raise the wire path's named

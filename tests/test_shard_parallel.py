@@ -329,6 +329,99 @@ def test_tp_sharded_round_allclose():
     _assert_trees(v_s, v_u, exact=False)
 
 
+def test_packed_tp_round_allclose():
+    # packed lanes on a true TP plan: GSPMD partitions the lane-step
+    # matmuls, cross-shard reductions reassociate — allclose, not bitwise,
+    # the same ~1 ULP caveat the padded TP path documents. (The bit-exact
+    # packed x FSDP-gather contract is tools/shard_smoke.py --packed, run
+    # in-process by test_shard_smoke_packed_arm below.)
+    trainer, train, test, cfg = _lm_problem(epochs=1)
+    cfg = dataclasses.replace(cfg, pack_lanes=2)
+    sim = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_tp"))
+    assert sim._pack and sim._spmd
+    v_s, _ = sim.run()
+    v_u, _ = FedSim(trainer, train, test, cfg,
+                    mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=False)
+
+
+@pytest.mark.slow  # ~90s: full TP x flash round recompile; the per-rank bit-identity and divisibility-fallback contracts stay tier-1 via the two unit tests below
+def test_tp_flash_round_head_parallel_allclose():
+    # flash attention back on the sharded path: under TP the pallas kernel
+    # runs PER RANK via the head-parallel shard_map wrap (ops/attention.py
+    # flash_attention_head_parallel) instead of gathering full heads — the
+    # sharded round must still match the unsharded flash twin
+    _, train, test, cfg = _lm_problem(epochs=1)
+    flash = ClientTrainer(
+        module=TransformerLM(vocab_size=32, embed_dim=16, num_layers=2,
+                             num_heads=2, max_len=8, attn_impl="flash"),
+        task="nwp", optimizer=optax.sgd(0.1, momentum=0.9), epochs=1,
+    )
+    v_s, _ = FedSim(flash, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_tp")).run()
+    v_u, _ = FedSim(flash, train, test, cfg,
+                    mesh=client_mesh(jax.devices()[:2])).run()
+    _assert_trees(v_s, v_u, exact=False)
+
+
+def test_flash_head_parallel_per_rank_matches_full_kernel():
+    # heads divide the axis: the per-rank kernel is bit-identical to the
+    # full-head kernel (attention is head-local math)
+    from jax.sharding import Mesh
+
+    from fedml_tpu.ops.attention import (
+        flash_attention,
+        flash_attention_head_parallel,
+    )
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.rand(2, 2, 8, 4), jnp.float32)
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:2]), (MODEL_AXIS,))
+    with mesh:
+        out = flash_attention_head_parallel(q, k, v, axis=MODEL_AXIS,
+                                            causal=True)
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.asarray(flash_attention(q, k, v, True)),
+    )
+    # no mesh active -> the plain kernel, same bits
+    out_plain = flash_attention_head_parallel(q, k, v, axis=MODEL_AXIS,
+                                              causal=True)
+    np.testing.assert_array_equal(np.asarray(out_plain), np.asarray(out))
+
+
+def test_flash_head_parallel_divisibility_fallback_warns(caplog):
+    # heads that don't divide the model axis: the wrap must fall back to
+    # gathered-xla attention WITH a loud warning naming the mismatch — a
+    # silent gather of the opaque kernel would defeat the shard plan
+    import logging
+
+    from jax.sharding import Mesh
+
+    from fedml_tpu.ops.attention import (
+        attention_reference,
+        flash_attention_head_parallel,
+    )
+
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.rand(2, 2, 8, 4), jnp.float32)
+               for _ in range(3))
+    mesh = Mesh(np.asarray(jax.devices()[:3]), (MODEL_AXIS,))
+    with mesh, caplog.at_level(logging.WARNING,
+                               logger="fedml_tpu.ops.attention"):
+        out = flash_attention_head_parallel(q, k, v, axis=MODEL_AXIS,
+                                            causal=True)
+    msgs = [r.getMessage() for r in caplog.records]
+    assert any("2 heads do not divide" in m and "3-way" in m for m in msgs), msgs
+    np.testing.assert_allclose(
+        np.asarray(out),
+        np.asarray(attention_reference(q, k, v, causal=True)),
+        rtol=1e-6, atol=1e-6,
+    )
+
+
 def test_sharded_round_composes_with_robust_defense():
     # the defense's clip-norm chain lives in two differently-fused
     # programs (standalone agg dispatch vs in-round aggregation), so its
@@ -401,9 +494,14 @@ def test_shard_summary_empty_without_rules():
 
 def test_shard_rules_guards():
     trainer, train, test, cfg = _lm_problem(epochs=1)
-    with pytest.raises(NotImplementedError, match="pack_lanes"):
-        FedSim(trainer, train, test, dataclasses.replace(
-            cfg, shard_rules="transformer_fsdp", pack_lanes=2))
+    # pack_lanes x shard_rules COMPOSES (docs/PERFORMANCE.md "Packed lanes
+    # on sharded plans") — construction must pick the packed pjit plan, not
+    # the old NotImplementedError guard
+    sim = FedSim(trainer, train, test, dataclasses.replace(
+        cfg, mesh_shape=(2, 2), shard_rules="transformer_fsdp",
+        pack_lanes=2))
+    assert sim._pack and sim._spmd
+    assert sim.shard_summary()["mode"] == "pjit"
     with pytest.raises(ValueError, match="block_dispatch"):
         FedSim(trainer, train, test, dataclasses.replace(
             cfg, shard_rules="transformer_fsdp", block_dispatch=True))
@@ -435,3 +533,18 @@ def test_shard_smoke_tool_runs():
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     assert mod.main([]) == 0
+
+
+def test_shard_smoke_packed_arm():
+    """The tier-1 packed x sharded bit-identity guard: tools/shard_smoke.py
+    --packed in-process — packed lanes on the (2, 2) fsdp mesh and on the
+    (1, 4) single-client-shard geometry, each bit-identical to the same
+    pack_lanes on an unsharded client mesh."""
+    import importlib.util
+    from pathlib import Path
+
+    path = Path(__file__).parent.parent / "tools" / "shard_smoke.py"
+    spec = importlib.util.spec_from_file_location("shard_smoke_packed", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.main(["--packed"]) == 0

@@ -237,6 +237,36 @@ def test_traced_purity_fires_and_negatives(tmp_path):
                for f in live)
 
 
+def test_traced_purity_method_handle_lowered_by_reference(tmp_path):
+    # the packed-sharded engine idiom: a BOUND METHOD handle passed to a
+    # lowering call (displib.lower(self._packed_agg_impl, ...)) — the
+    # scanner must record the terminal attribute name so the method body
+    # is checked like any other traced program
+    live, _, _ = lint(tmp_path, {"m.py": """
+        import time
+
+        from fedml_tpu.parallel import dispatch as displib
+
+        class Engine:
+            def _packed_agg_impl(self, x):
+                t = time.time()         # host call in traced body: fires
+                return x + t
+
+            def _host_helper(self, x):
+                time.time()             # never lowered: clean
+                return x
+
+            def build(self):
+                self._fn = displib.lower(
+                    self._packed_agg_impl,
+                    mesh=None, in_specs=(), out_specs=(),
+                )
+        """}, select=["traced-purity"])
+    assert len(live) == 1 and live[0].rule == "traced-purity"
+    assert "time.time()" in live[0].message
+    assert "_packed_agg_impl" in live[0].message
+
+
 def test_traced_purity_module_wide_bans(tmp_path):
     # banned-module-calls: np.random.* is illegal at ANY scope in modules
     # under the configured prefix (the population subsystem's replay-

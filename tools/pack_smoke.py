@@ -4,6 +4,10 @@ execution") vs the padded path, on a deliberately skewed (power-law-ish)
 partition, asserting identical round metrics and bit-identical final
 variables — the cheap tier-1 guard against silent divergence between the two
 execution modes (the packed-lane analogue of tools/pipeline_smoke.py).
+Packed-vs-padded on SHARDED plans is tools/shard_smoke.py --packed's
+contract instead (packed-sharded pinned against packed-unsharded — see
+docs/PERFORMANCE.md "Packed lanes on sharded plans" for why the padded
+comparison carries a fusion caveat there).
 
     JAX_PLATFORMS=cpu python tools/pack_smoke.py
 """

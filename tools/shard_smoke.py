@@ -15,7 +15,18 @@ Two arms run by default on XLA:CPU host devices:
   the whole mesh given to its model, ``cohort_execution="scan"``) vs the
   single-device program.
 
-    JAX_PLATFORMS=cpu python tools/shard_smoke.py [--bench]
+    JAX_PLATFORMS=cpu python tools/shard_smoke.py [--bench] [--packed]
+
+``--packed`` runs the packed-lane composition arms instead (docs/
+PERFORMANCE.md "Packed lanes on sharded plans"): ``pack_lanes`` on the
+(2, 2) fsdp mesh and on the (1, 4) single-client-shard geometry, each vs
+the SAME ``pack_lanes`` on an unsharded client mesh of equal client-axis
+extent — bit-identical variables and metrics, the tier-1 guard that
+gather-plan sharding composes with lane packing without touching the
+model math. (Packed vs padded on one mesh is pack_smoke's separate
+contract and carries its own transformer fusion caveat, so the packed
+arms pin against packed twins, not padded ones.) Tier-1 runs this arm
+in-process (tests/test_shard_parallel.py).
 
 ``--bench`` additionally reports sharded vs unsharded rounds/sec as one
 JSON line (bench.py's shard A/B rides this on CPU-fallback runs).
@@ -104,14 +115,19 @@ def main(argv=None) -> int:
     from fedml_tpu.parallel.mesh import client_mesh
     from fedml_tpu.sim.engine import FedSim, SimConfig
 
-    # persistent XLA compile cache (the test suite's location): standalone
-    # and bench-subprocess runs skip recompiling the round programs
+    # persistent XLA compile cache (the test suite's repo-local gitignored
+    # dir): standalone and bench-subprocess runs skip recompiling the round
+    # programs tier-1 already built, and vice versa
     jax.config.update("jax_compilation_cache_dir",
                       os.environ.get("FEDML_TPU_JAX_CACHE",
-                                     "/tmp/fedml_tpu_jax_cache"))
+                                     os.path.join(
+                                         os.path.dirname(os.path.dirname(
+                                             os.path.abspath(__file__))),
+                                         ".jax_cache")))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     bench = bool(argv) and "--bench" in argv
+    packed = bool(argv) and "--packed" in argv
     devices = jax.devices()
     if len(devices) < 4:
         print(json.dumps({
@@ -132,6 +148,38 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         v, h = sim.run()
         return (v, h), time.perf_counter() - t0, sim
+
+    if packed:
+        # Packed-lane composition arms: pack_lanes on a sharded plan vs the
+        # SAME pack_lanes on an unsharded client mesh of equal client-axis
+        # extent — the acceptance contract is packed-sharded == unsharded
+        # packed, bit for bit (gather plans; the padded-vs-packed relation
+        # is pack_smoke's separate contract and carries its own transformer
+        # fusion caveat).
+        pack_cfg = dataclasses.replace(cfg, pack_lanes=2)
+        res_p, _, sim_p = run(dataclasses.replace(
+            pack_cfg, mesh_shape=(2, 2), shard_rules="transformer_fsdp"
+        ))
+        assert sim_p._pack and sim_p._spmd, "packed arm must compose"
+        assert sim_p.shard_summary()["mode"] == "pjit", sim_p.shard_summary()
+        res_pu, _, _ = run(pack_cfg, mesh=client_mesh(devices[:2]))
+        _assert_same("packed 2x2 fsdp", res_p, res_pu)
+
+        # the flagship geometry with lanes: one client shard, the whole
+        # model axis to each lane step, vs the 1-device packed program
+        res_p2, _, _ = run(dataclasses.replace(
+            pack_cfg, mesh_shape=(1, 4), shard_rules="transformer_fsdp"
+        ))
+        res_pu2, _, _ = run(pack_cfg, mesh=client_mesh(devices[:1]))
+        _assert_same("packed 1x4 fsdp", res_p2, res_pu2)
+        metric_keys = sorted(k for k in res_pu[1][-1] if k != "round_time")
+        print(
+            f"shard smoke --packed OK: {ROUNDS} rounds, packed-sharded == "
+            f"packed-unsharded on {metric_keys} and final variables "
+            "(2x2 fsdp + 1x4 arms)"
+        )
+        if not bench:
+            return 0
 
     # arm 1: 2x2 clients x model, FSDP-gather rules, vs 2-client-shard
     # unsharded (same client-axis extent -> same padding and rng chains)
