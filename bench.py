@@ -1072,6 +1072,32 @@ def bench_async_ab(n_rounds: int = 3):
         out[f"async_f{fan_in}_tree_uploads_per_sec"] = round(
             n_rounds * workers / dt, 1)
         out[f"async_f{fan_in}_tree_models_per_sec"] = round(n_rounds / dt, 2)
+
+    # 3-tier async cascade arms (async_agg/cascade.py): synthesized leaf
+    # uploads through REAL barrier-free edge tiers at fan-in 4/16/32. The
+    # headline columns: uploads/sec scaling with fan-in (fan^3 leaves per
+    # round through the same per-tier code path), interior tier-to-tier
+    # bytes raw-f64 vs q8-encoded (the >=4x bar), and the per-tier
+    # peak-resident-state-vs-model-size probe (O(model) per tier, not
+    # O(children)) plus the process RSS delta after warmup.
+    from fedml_tpu.async_agg.cascade import run_cascade
+
+    model_size = 1000
+    out["cascade_model_bytes"] = model_size * 4
+    for fan in (4, 16, 32):
+        rep = run_cascade((fan, fan, fan), rounds=2, model_size=model_size,
+                          buffer_goal=fan, tier_staleness="const")
+        out[f"cascade_f{fan}_uploads_per_sec"] = round(rep.uploads_per_s, 1)
+        out[f"cascade_f{fan}_interior_raw_bytes"] = rep.interior_dense_bytes
+        out[f"cascade_f{fan}_tier_state_bytes"] = rep.max_tier_state_bytes
+        out[f"cascade_f{fan}_state_per_model"] = round(
+            rep.max_tier_state_bytes / (model_size * 4), 2)
+        out[f"cascade_f{fan}_rss_delta_kb"] = rep.rss_delta_kb
+        enc = run_cascade((fan, fan, fan), rounds=2, model_size=model_size,
+                          buffer_goal=fan, tier_uplink_codec="q8")
+        out[f"cascade_f{fan}_interior_enc_bytes"] = enc.interior_uplink_bytes
+        out[f"cascade_f{fan}_interior_ratio"] = round(
+            enc.interior_dense_bytes / max(enc.interior_uplink_bytes, 1), 2)
     return out
 
 

@@ -49,7 +49,7 @@ from fedml_tpu.algorithms.robust_distributed import (
     RobustDistAggregator,
     _RobustServerMixin,
 )
-from fedml_tpu.async_agg.staleness import make_staleness_fn
+from fedml_tpu.async_agg.staleness import make_staleness_fn, memoize_staleness
 from fedml_tpu.comm.message import Message
 from fedml_tpu.obs import metrics as metricslib
 from fedml_tpu.obs import registry
@@ -179,7 +179,8 @@ class AsyncFedAvgServerManager(FedAvgServerManager):
                 "the server would deadlock"
             )
         self.staleness_weight = str(staleness_weight)
-        self._staleness_fn = make_staleness_fn(self.staleness_weight)
+        self._staleness_fn = memoize_staleness(
+            make_staleness_fn(self.staleness_weight))
         self._async_stats = async_stats
         # workers awaiting the next emission
         self._parked: set[int] = set()  # guarded-by: _round_lock

@@ -50,6 +50,24 @@ STALENESS_FAMILIES = {
 }
 
 
+def memoize_staleness(fn: StalenessFn) -> StalenessFn:
+    """Cache weights by integer staleness distance. The domain is tiny (a
+    handful of distinct lags per run) but the fold path is hot — an async
+    edge tier at 10^6 uploads evaluates the family once per fold, and
+    ``poly``'s ``**`` is measurably slower than a dict hit. Exact: the
+    family functions are pure maps from ``d``, so caching cannot change a
+    single fold weight (``const`` stays bit-identical to sync)."""
+    cache: dict[int, float] = {}
+
+    def cached(d: int) -> float:
+        w = cache.get(d)
+        if w is None:
+            w = cache[d] = float(fn(d))
+        return w
+
+    return cached
+
+
 def make_staleness_fn(spec: str) -> StalenessFn:
     """Parse a staleness-weight spec: ``const`` | ``poly:a`` |
     ``hinge:a,b`` (e.g. ``poly:0.5``, ``hinge:0.25,4``). Raises on unknown

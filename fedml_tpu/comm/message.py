@@ -91,6 +91,15 @@ class Message:
     MSG_ARG_KEY_MODEL_VERSION = "model_version"
     MSG_ARG_KEY_WEIGHT_SUM = "weight_sum"
     MSG_ARG_KEY_FOLD_COUNT = "fold_count"
+    # async edge tiers (fedml_tpu/async_agg/tree.py): a barrier-free tier
+    # emits SEVERAL partials per round — the emission sequence number makes
+    # replayed legs idempotent at the parent ((round, seq) must advance
+    # lexicographically per sender), and the window-complete flag marks the
+    # emission that closes this tier's round contribution (the parent's
+    # round barrier counts only complete emissions; a missing flag means a
+    # legacy single-partial tier and is read as complete)
+    MSG_ARG_KEY_PARTIAL_SEQ = "partial_seq"
+    MSG_ARG_KEY_WINDOW_COMPLETE = "window_complete"
     # downlink delta coding (compress/downlink.py, docs/COMPRESSION.md
     # "Downlink delta coding"): a delta-coded sync's payload reconstructs
     # the stamped MODEL_VERSION from this base version — a header-only

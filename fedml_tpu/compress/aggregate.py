@@ -155,3 +155,56 @@ def accumulate_encoded(
         for leaf in dense:
             acc[off : off + leaf.size] += weight * leaf.astype(np.float64)
             off += leaf.size
+
+
+# ---------------------------------------------------------------------------
+# Tier partials through the codec plane (async_agg/tree.py encoded uplinks)
+# ---------------------------------------------------------------------------
+
+
+def encode_partial(
+    acc64: np.ndarray, weight_sum: float, base64: np.ndarray | None,
+    codec: Codec, rng,
+) -> EncodedUpdate:
+    """Encode an edge tier's raw partial (the f64 accumulator
+    ``sum_i w_i x_i``) for the tier-to-tier uplink.
+
+    Delta-domain codecs ship ``acc - weight_sum * base`` as f32 (the PR 14
+    delta framing applied to the accumulator: the parent holds the SAME
+    round global, so the weighted base mass is reconstructable and only the
+    update mass pays for quantization). The ``none`` codec ships the f64
+    accumulator itself — a pure passthrough, so a none-coded partial is
+    BIT-IDENTICAL to the raw-f64 wire payload (the identity arm in
+    tools/async_smoke.py)."""
+    if codec.delta_domain:
+        if base64 is None:
+            raise ValueError(
+                f"delta-domain tier codec {codec.name!r} needs the round "
+                "global as its base (dense downlink only)"
+            )
+        tree = {"acc": (acc64 - float(weight_sum) * base64).astype(np.float32)}
+    else:
+        tree = {"acc": acc64}
+    with trace.span("compress/encode", scheme=codec.name, partial=True):
+        return codec.encode(tree, rng)
+
+
+def decode_partial(
+    enc: EncodedUpdate, weight_sum: float, base64: np.ndarray | None,
+    codec: Codec,
+) -> np.ndarray:
+    """Inverse of :func:`encode_partial`: recover the f64 accumulator a
+    parent tier folds. The ``none`` path is a dtype-preserving view — no
+    cast touches the bits."""
+    with trace.span("compress/decode", scheme=enc.scheme, partial=True):
+        leaves = _flat_leaves(codec.decode(enc))
+    arr = (np.asarray(leaves[0], np.float64) if len(leaves) == 1
+           else np.concatenate([l.astype(np.float64) for l in leaves]))
+    if codec.delta_domain:
+        if base64 is None:
+            raise ValueError(
+                f"delta-domain tier codec {codec.name!r} needs the round "
+                "global to reconstruct the partial"
+            )
+        arr = arr + float(weight_sum) * base64
+    return arr

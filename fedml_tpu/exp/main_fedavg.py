@@ -104,15 +104,20 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "streaming accumulator forwarding one folded "
                              "super-update)")
     parser.add_argument("--buffer_goal", type=int, default=0,
-                        help="async mode: arrivals per emitted model "
+                        help="async/tree mode: arrivals per emitted model "
                              "version (0 = the worker count, which with "
                              "the const staleness weight reproduces the "
-                             "sync path bit-for-bit)")
+                             "sync path bit-for-bit). Under --server_mode "
+                             "tree this is the per-EDGE fold window: each "
+                             "tier forwards a partial upstream every this "
+                             "many child arrivals instead of per barrier")
     parser.add_argument("--staleness_weight", type=str, default="const",
-                        help="async mode: staleness decay family for "
+                        help="async/tree mode: staleness decay family for "
                              "folds of old-version uploads — const | "
                              "poly:a | hinge:a,b (FedAsync family; "
-                             "s(0) == 1 always)")
+                             "s(0) == 1 always). Under --server_mode tree "
+                             "it weights stale child uploads at each edge "
+                             "tier")
     parser.add_argument("--tree_fan_ins", type=str, default=None,
                         help="tree mode: comma-separated fan-in per tier, "
                              "root downward, last entry = clients per leaf "
@@ -120,6 +125,30 @@ def add_args(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "the leaf count must equal "
                              "--client_num_per_round. Default: one edge "
                              "over the whole cohort")
+    parser.add_argument("--tree_transport", type=str, default="loopback",
+                        choices=["loopback", "shm", "grpc"],
+                        help="tree mode: transport each tier cell's comm "
+                             "fabric runs on — loopback (in-process), shm "
+                             "(one shared-memory ring namespace per cell), "
+                             "grpc (localhost port block per cell, needs "
+                             "grpcio)")
+    parser.add_argument("--tier_timeout", type=float, default=0.0,
+                        help="tree mode: elastic per-tier window timeout "
+                             "in seconds — an edge whose children stall "
+                             "past this emits the partial it has (complete "
+                             "if the window never opened this round is "
+                             "covered by the root's round timeout). 0 = "
+                             "wait for the buffer goal. Arms the async "
+                             "tier discipline")
+    parser.add_argument("--tier_compressor", type=str, default=None,
+                        help="tree mode: tier-to-tier uplink codec for "
+                             "edge partials (encoded through "
+                             "compress/aggregate.py encode_partial): none "
+                             "| bf16 | topk | q8 | q4, composable with "
+                             "'+'. 'none' ships the raw f64 accumulator "
+                             "bit-exactly; delta codecs frame the partial "
+                             "against the round global. Arms the async "
+                             "tier discipline")
     # algorithm switch (fedall) + algorithm-specific knobs
     parser.add_argument("--algorithm", type=str, default="fedavg",
                         choices=["fedavg", "fedopt", "fedprox", "fednova", "fedgan",
@@ -467,6 +496,7 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
     comm_stats: dict = {}
     robust_stats: dict = {}
     async_stats: dict = {}
+    tier_stats: dict = {}
     # fleet telemetry plane (obs/registry.py, docs/OBSERVABILITY.md "Fleet
     # telemetry"): the runner fills the dict with per-round fleet
     # snapshots + totals; this entry persists them as fleet.jsonl/.json in
@@ -595,11 +625,52 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
             )
         logging.info("tree mode: fan-ins %s (%d leaves, %d edge tiers)",
                      fan_ins, topo.leaf_count, topo.tier_count)
-        final_variables = run_tree_fedavg_loopback(
+        tree_kwargs: dict = {"tier_stats": tier_stats}
+        if "comm_stats" not in downlink_kwargs:
+            tree_kwargs["comm_stats"] = comm_stats
+        if getattr(args, "buffer_goal", 0):
+            tree_kwargs["buffer_goal"] = args.buffer_goal
+        if getattr(args, "staleness_weight", "const") != "const":
+            tree_kwargs["tier_staleness"] = args.staleness_weight
+        if getattr(args, "tier_timeout", 0.0):
+            tree_kwargs["tier_timeout"] = args.tier_timeout
+        if getattr(args, "tier_compressor", None) is not None:
+            tree_kwargs["tier_uplink_codec"] = args.tier_compressor
+        if codec_kwargs:
+            # the same client->server codec the flat runners take, applied
+            # at the leaf edges (each decodes its children's encoded deltas
+            # into the model domain before folding)
+            tree_kwargs["client_codec"] = codec_kwargs["codec"]
+            tree_kwargs["client_error_feedback"] = codec_kwargs[
+                "error_feedback"]
+        if pop_kwargs:
+            # one churn trace over the whole hierarchy: the adapter indexes
+            # by GLOBAL leaf number, so the tree sees the same per-client
+            # draws the flat wire path would
+            tree_kwargs["population"] = pop_kwargs["population"]
+            tree_kwargs["fault_seed"] = pop_kwargs["population"].seed
+        for k in ("retry_policy", "heartbeat_interval"):
+            if k in ft_kwargs:
+                tree_kwargs[k] = ft_kwargs[k]
+        transport = getattr(args, "tree_transport", "loopback")
+        if transport == "shm":
+            from fedml_tpu.async_agg.tree import run_tree_fedavg_shm
+
+            tree_runner = run_tree_fedavg_shm
+        elif transport == "grpc":
+            from fedml_tpu.async_agg.tree import GrpcGroupComm
+
+            tree_runner = run_tree_fedavg_loopback
+            tree_kwargs["make_group_comm"] = GrpcGroupComm(
+                base_port=getattr(args, "grpc_base_port", 8890))
+        else:
+            tree_runner = run_tree_fedavg_loopback
+        final_variables = tree_runner(
             trainer, ds.train, topo, cfg.comm_round, cfg.batch_size,
             seed=cfg.seed, on_round_done=on_round, init_overrides=overrides,
             **downlink_kwargs,
             **fleet_kwargs,
+            **tree_kwargs,
         )
     else:
         mode_kwargs = {}
@@ -631,6 +702,8 @@ def _run_message_passing(args, trainer, ds, cfg, metrics) -> list[dict]:
         logging.info("bytes on wire: %s", comm_stats["totals"])
     if async_stats.get("totals"):
         logging.info("async server: %s", async_stats["totals"])
+    if tier_stats.get("totals"):
+        logging.info("edge tiers: %s", tier_stats["totals"])
     if fleet_stats is not None:
         import json
         import os
@@ -961,7 +1034,7 @@ def _run(args) -> list[dict]:
                 f"--server_mode {server_mode} and --is_mobile both redefine "
                 "the server protocol; pick one"
             )
-    if server_mode != "async":
+    if server_mode not in ("async", "tree"):
         misapplied = [
             flag for flag, val in [
                 ("--buffer_goal", getattr(args, "buffer_goal", 0)),
@@ -972,45 +1045,49 @@ def _run(args) -> list[dict]:
         if misapplied:
             # same loud-rejection convention as the unwired tree flags
             # below: silently dropping these would fake a staleness
-            # experiment as a plain sync/tree run
+            # experiment as a plain sync run
             raise NotImplementedError(
                 f"not valid with --server_mode {server_mode}: "
-                f"{', '.join(misapplied)} (buffered-async server knobs) — "
-                "pick --server_mode async"
+                f"{', '.join(misapplied)} (buffered-async fold knobs) — "
+                "pick --server_mode async|tree"
             )
-    if server_mode != "tree" and getattr(args, "tree_fan_ins", None):
-        raise NotImplementedError(
-            "--tree_fan_ins shapes the hierarchical tier topology and is "
-            f"ignored under --server_mode {server_mode} — pick "
-            "--server_mode tree"
-        )
+    if server_mode != "tree":
+        tree_only = [
+            flag for flag, val in [
+                ("--tree_fan_ins", getattr(args, "tree_fan_ins", None)),
+                ("--tree_transport",
+                 getattr(args, "tree_transport", "loopback") != "loopback"),
+                ("--tier_timeout", getattr(args, "tier_timeout", 0.0)),
+                ("--tier_compressor",
+                 getattr(args, "tier_compressor", None) is not None),
+            ] if val
+        ]
+        if tree_only:
+            raise NotImplementedError(
+                f"{', '.join(tree_only)} shape the hierarchical tier plane "
+                f"and are ignored under --server_mode {server_mode} — pick "
+                "--server_mode tree"
+            )
     if server_mode == "tree":
         if args.backend != "loopback":
             raise NotImplementedError(
-                "--server_mode tree runs each tier cell on its own comm "
-                "fabric; this entry wires the loopback cells — drive other "
-                "transports through "
-                "fedml_tpu.async_agg.tree.run_tree_fedavg(make_group_comm=...)"
-            )
-        if getattr(args, "compressor", "none") != "none":
-            raise NotImplementedError(
-                "--server_mode tree forwards raw f64 partials between "
-                "tiers; the encoded-update uplink composes with "
-                "--server_mode sync|async only"
+                "--server_mode tree builds its own comm fabric per tier "
+                "cell; the cell transport is --tree_transport "
+                "loopback|shm|grpc, not --backend — keep --backend "
+                "loopback"
             )
         if args.algorithm == "fedavg_robust":
             raise NotImplementedError(
-                "--server_mode tree has no per-tier defense yet; "
-                "--algorithm fedavg_robust composes with "
-                "--server_mode sync|async"
+                "--algorithm fedavg_robust's flat-cohort rules "
+                "(median/krum/...) need every upload resident and do not "
+                "compose with streaming tiers; the tree's per-tier "
+                "clip+DP defense is the harness API "
+                "(async_agg.tree.run_tree_fedavg(tier_defense=...)) — "
+                "use --server_mode sync|async for fedavg_robust"
             )
         unwired = [
             flag for flag, val in [
                 ("--fault_spec", getattr(args, "fault_spec", None)),
-                ("--population", getattr(args, "population", None)),
-                ("--send_retries", getattr(args, "send_retries", 0)),
-                ("--heartbeat_interval",
-                 getattr(args, "heartbeat_interval", 0.0)),
                 ("--checkpoint_dir", getattr(args, "checkpoint_dir", None)),
                 ("--resume", getattr(args, "resume", 0)),
             ] if val
@@ -1024,9 +1101,9 @@ def _run(args) -> list[dict]:
                 f"{', '.join(unwired)} not wired into --server_mode tree "
                 "yet: the tree branch drives its own per-cell harness "
                 "(async_agg.tree.run_tree_fedavg), which does not take the "
-                "fault/retry/heartbeat/checkpoint planes — use "
-                "--server_mode sync|async, or drive the harness API "
-                "directly"
+                "fault-injection/checkpoint planes — use --server_mode "
+                "sync|async, or drive the harness API directly "
+                "(churn rides --population instead)"
             )
     if (getattr(args, "send_retries", 0)
             or getattr(args, "heartbeat_interval", 0.0)) and args.backend == "sim":

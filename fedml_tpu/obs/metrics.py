@@ -42,6 +42,17 @@ COMM_DOWNLINK_KEYFRAMES = "Comm/DownlinkKeyframes"
 # ratio keys are derived, not additive — totals() must never sum them
 _RATIO_KEYS = (COMM_RATIO, COMM_DOWNLINK_RATIO)
 
+# Interior (tier-to-tier) uplink bytes in tree mode (async_agg/tree.py,
+# docs/PERFORMANCE.md "Barrier-free aggregation"): actual bytes each edge
+# tier's partial put on the wire toward its parent vs the raw-f64
+# accumulator equivalent. With the tier uplink codec armed the partial
+# ships as an EncodedUpdate (delta framing against the round global), so
+# the ratio measures real interior-bandwidth savings; without a codec the
+# two are equal. Summed over every edge into tier_stats/comm_stats totals
+# by run_tree_fedavg and the cascade harness.
+COMM_TIER_UPLINK_BYTES = "Comm/TierUplinkBytes"
+COMM_TIER_UPLINK_DENSE_BYTES = "Comm/TierUplinkDenseBytes"
+
 # retry/backoff send plane (comm/retry.py, docs/ROBUSTNESS.md "Failure
 # recovery"): how many send attempts were re-tried after a transient
 # failure over the whole run. Emitted into comm_stats totals by

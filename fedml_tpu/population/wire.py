@@ -62,6 +62,14 @@ class PopulationWireAdapter:
     def drops_uploads(self) -> bool:
         return any(s.drop > 0 for s in self.fault_specs.values())
 
+    def spec_for(self, rank: int):
+        """Active fault spec for one rank (None = identity, leave the
+        transport unwrapped). Tree mode indexes by GLOBAL leaf number
+        (``leaf_base + cell_rank``), so one churn trace spans every cell of
+        the hierarchy with the same per-client draws the flat wire path
+        would see."""
+        return self.fault_specs.get(int(rank))
+
     def describe(self) -> dict:
         return {
             "kind": "wire",

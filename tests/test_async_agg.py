@@ -647,14 +647,17 @@ def test_main_fedavg_server_mode_guards():
     with pytest.raises(NotImplementedError, match="server_mode"):
         main_fedavg.run(args_for("--server_mode", "async",
                                  "--backend", "sim"))
-    with pytest.raises(NotImplementedError, match="loopback cells"):
+    # the cell transport is --tree_transport, not --backend
+    with pytest.raises(NotImplementedError, match="tree_transport"):
         main_fedavg.run(args_for("--server_mode", "tree",
                                  "--backend", "grpc"))
-    with pytest.raises(NotImplementedError, match="encoded-update"):
+    # flat-cohort robust rules keep every upload resident — they do not
+    # stream through tiers (the tree's defense is clip+DP per tier)
+    with pytest.raises(NotImplementedError, match="fedavg_robust"):
         main_fedavg.run(args_for("--server_mode", "tree",
                                  "--backend", "loopback",
-                                 "--compressor", "q8"))
-    # the fault/retry/heartbeat/checkpoint planes are consumed by the flat
+                                 "--algorithm", "fedavg_robust"))
+    # the fault-injection/checkpoint planes are consumed by the flat
     # runner the tree branch bypasses — silent no-ops would fake recovery
     # or robustness experiments, so they are rejected loudly
     with pytest.raises(NotImplementedError, match="--checkpoint_dir"):
@@ -665,19 +668,32 @@ def test_main_fedavg_server_mode_guards():
         main_fedavg.run(args_for("--server_mode", "tree",
                                  "--backend", "loopback",
                                  "--fault_spec", "2:dup=1.0"))
-    # async-only knobs under the wrong mode: rejected, not silently dropped
+    # barrier-free fold knobs under the wrong mode: rejected, not dropped
     with pytest.raises(NotImplementedError, match="--staleness_weight"):
         main_fedavg.run(args_for("--server_mode", "sync",
                                  "--backend", "loopback",
                                  "--staleness_weight", "poly:0.5"))
     with pytest.raises(NotImplementedError, match="--buffer_goal"):
-        main_fedavg.run(args_for("--server_mode", "tree",
+        main_fedavg.run(args_for("--server_mode", "sync",
                                  "--backend", "loopback",
                                  "--buffer_goal", "4"))
     with pytest.raises(NotImplementedError, match="--tree_fan_ins"):
         main_fedavg.run(args_for("--server_mode", "async",
                                  "--backend", "loopback",
                                  "--tree_fan_ins", "2,2"))
+    # tier-plane knobs outside tree mode: same loud rejection
+    with pytest.raises(NotImplementedError, match="--tier_timeout"):
+        main_fedavg.run(args_for("--server_mode", "async",
+                                 "--backend", "loopback",
+                                 "--tier_timeout", "0.5"))
+    with pytest.raises(NotImplementedError, match="--tier_compressor"):
+        main_fedavg.run(args_for("--server_mode", "sync",
+                                 "--backend", "loopback",
+                                 "--tier_compressor", "q8"))
+    with pytest.raises(NotImplementedError, match="--tree_transport"):
+        main_fedavg.run(args_for("--server_mode", "sync",
+                                 "--backend", "loopback",
+                                 "--tree_transport", "shm"))
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,587 @@
+"""Async edge-tier tests (docs/PERFORMANCE.md "Barrier-free aggregation",
+docs/ROBUSTNESS.md "Elastic tier timeouts"): the per-tier bit-identity
+ladder (async edge at ``buffer_goal == fan_in`` == sync tree == flat
+server, none-codec encoded partial == raw f64), the fold-on-arrival
+window discipline (buffer emissions, seq/window-complete flags, staleness
+weighting, duplicate/replay guards), elastic tier flushes, encoded
+partial roundtrips, per-tier clip+DP defense, tier-labelled
+EmptyRoundError, the shm/grpc tree transports, and the churned cascade
+harness. The 10^6-upload soak is marked slow."""
+
+import argparse
+import logging
+
+import numpy as np
+import pytest
+
+from fedml_tpu.algorithms.fedavg_distributed import EmptyRoundError, MyMessage
+from fedml_tpu.async_agg.cascade import (
+    InlineCommManager,
+    InlineFabric,
+    run_cascade,
+)
+from fedml_tpu.async_agg.tree import (
+    EdgeAggregatorManager,
+    EdgeAsyncConfig,
+    TierAggregator,
+    TreeFedAvgServerManager,
+    TreeMessage,
+    run_tree_fedavg_loopback,
+    run_tree_fedavg_shm,
+)
+from fedml_tpu.comm.message import Message, pack_pytree
+from fedml_tpu.compress import make_codec
+
+
+def _lr_fixture(workers=4, samples=24):
+    import optax
+
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=samples,
+                              num_classes=4, seed=11)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+    return trainer, train
+
+
+def _snap(v):
+    import jax
+
+    return [np.asarray(l).copy() for l in jax.tree.leaves(v)]
+
+
+# ---------------------------------------------------------------------------
+# per-tier bit-identity ladder
+# ---------------------------------------------------------------------------
+
+
+def test_async_edge_ladder_bit_identical_two_tier():
+    """On a (2,2) hierarchy every cell has exactly TWO uploaders, and an
+    IEEE f64 two-term fold is commutative — so racing arrival order cannot
+    perturb the tally and the three arms must agree BIT-FOR-BIT, per round
+    and final: sync barrier tree == async edges at ``buffer_goal ==
+    fan_in`` == async edges with the none-codec encoded uplink."""
+    trainer, train = _lr_fixture(workers=4)
+
+    def run(**kwargs):
+        per_round = []
+        final = run_tree_fedavg_loopback(
+            trainer, train, (2, 2), 2, 8,
+            on_round_done=lambda r, v: per_round.append((r, _snap(v))),
+            **kwargs,
+        )
+        return _snap(final), per_round
+
+    sync_final, sync_rounds = run()
+    async_final, async_rounds = run(buffer_goal=2, tier_staleness="const")
+    enc_final, enc_rounds = run(buffer_goal=2, tier_uplink_codec="none")
+    for arm_final, arm_rounds, name in (
+        (async_final, async_rounds, "async buffer_goal==fan_in"),
+        (enc_final, enc_rounds, "encoded none-codec uplink"),
+    ):
+        assert [r for r, _ in arm_rounds] == [r for r, _ in sync_rounds]
+        for (ra, la), (_, ls) in zip(arm_rounds, sync_rounds):
+            for a, b in zip(la, ls):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"round {ra}: {name} != sync tree")
+        for a, b in zip(arm_final, sync_final):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"final: {name} != sync tree")
+
+
+def test_async_edge_matches_flat_server_ordered():
+    """1-tier tree with a rank-ordered leaf fabric: the async edge at full
+    buffer folds uploads in the flat server's exact sequence, so every
+    round model equals the flat sync server's bit-for-bit (the ladder's
+    flat rung; tools/async_smoke.py holds it in tier-1 too)."""
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.comm.loopback import (
+        LoopbackCommManager,
+        LoopbackFabric,
+        OrderedUplinkFabric,
+    )
+
+    workers = 4
+    trainer, train = _lr_fixture(workers=workers)
+
+    flat_fabric = OrderedUplinkFabric(
+        workers + 1, workers, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER)
+    flat_rounds = []
+    flat_final = run_distributed_fedavg(
+        trainer, train, worker_num=workers, round_num=2, batch_size=8,
+        make_comm=lambda r: LoopbackCommManager(flat_fabric, r),
+        on_round_done=lambda r, v: flat_rounds.append((r, _snap(v))),
+    )
+
+    def make_group(path, world):
+        fabric = (LoopbackFabric(world) if path == () else
+                  OrderedUplinkFabric(
+                      world, workers,
+                      MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER))
+        return lambda r: LoopbackCommManager(fabric, r)
+
+    tree_rounds = []
+    tree_final = run_tree_fedavg_loopback(
+        trainer, train, (1, workers), 2, 8,
+        on_round_done=lambda r, v: tree_rounds.append((r, _snap(v))),
+        make_group_comm=make_group, buffer_goal=workers,
+        tier_staleness="const",
+    )
+    assert [r for r, _ in tree_rounds] == [r for r, _ in flat_rounds]
+    for (ra, la), (_, ls) in zip(tree_rounds, flat_rounds):
+        for a, b in zip(la, ls):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"round {ra}: async edge != flat server")
+    for a, b in zip(_snap(tree_final), _snap(flat_final)):
+        np.testing.assert_array_equal(a, b)
+
+
+# ---------------------------------------------------------------------------
+# window discipline units (one edge cell over inline transports)
+# ---------------------------------------------------------------------------
+
+
+class _Tap:
+    """Recording observer on the root's comm: sees every tier partial."""
+
+    def __init__(self):
+        self.partials = []
+
+    def receive_message(self, msg_type, msg):
+        if msg_type == TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL:
+            self.partials.append(msg)
+
+
+def _edge_cell(child_num=3, model_size=16, rounds=4, **cfg_kwargs):
+    """One root + one leaf edge over inline transports, init sync sent."""
+    codec = cfg_kwargs.get("uplink_codec")
+    if isinstance(codec, str):
+        cfg_kwargs["uplink_codec"] = make_codec(codec)
+    async_cfg = EdgeAsyncConfig(**cfg_kwargs)
+    flat, desc = pack_pytree({"w": np.zeros(model_size, np.float32)})
+    rounds_done = []
+    server = TreeFedAvgServerManager(
+        InlineCommManager(InlineFabric(2), 0), 1, rounds, flat, desc,
+        client_num_in_total=child_num,
+        on_round_done=lambda r, f: rounds_done.append(r),
+        tier_uplink_codec=cfg_kwargs.get("uplink_codec"),
+    )
+    tap = _Tap()
+    edge = EdgeAggregatorManager(
+        up_comm=InlineCommManager(server.comm.fabric, 1), up_rank=1,
+        down_comm=InlineCommManager(InlineFabric(child_num + 1), 0),
+        child_num=child_num, leaf_base=0, leaf_total=child_num,
+        client_num_in_total=child_num, children_are_leaves=True,
+        async_config=async_cfg, model_desc=desc,
+    )
+    edge.register_message_receive_handlers()
+    server.register_message_receive_handlers()
+    server.comm.add_observer(tap)
+    server.send_init_msg()
+    return server, edge, tap, rounds_done
+
+
+def _upload(child, round_idx, x, n=4.0):
+    msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, child, 0)
+    msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                   np.ascontiguousarray(x.astype(np.float32)).view(np.uint8))
+    msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, float(n))
+    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, round_idx)
+    return msg
+
+
+def test_buffer_emissions_carry_seq_and_complete_flags():
+    """buffer_goal=2 over 3 children: the first two arrivals emit seq 0
+    with window_complete=0 (the parent folds it but its barrier stays
+    open); the third emits seq 1 complete=1 and closes the round."""
+    server, edge, tap, rounds_done = _edge_cell(child_num=3, buffer_goal=2)
+    x = np.full(16, 0.5, np.float32)
+    edge.comm.notify(_upload(1, 0, x))
+    assert tap.partials == [] and rounds_done == []
+    edge.comm.notify(_upload(2, 0, x))
+    assert len(tap.partials) == 1 and rounds_done == []
+    first = tap.partials[0]
+    assert first.get(TreeMessage.MSG_ARG_KEY_PARTIAL_SEQ) == 0
+    assert first.get(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE) == 0
+    assert first.get(TreeMessage.MSG_ARG_KEY_FOLD_COUNT) == 2
+    edge.comm.notify(_upload(3, 0, x))
+    assert len(tap.partials) == 2 and rounds_done == [0]
+    second = tap.partials[1]
+    assert second.get(TreeMessage.MSG_ARG_KEY_PARTIAL_SEQ) == 1
+    assert second.get(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE) == 1
+    # weight mass is conserved across the two emissions
+    total_w = sum(float(p.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
+                  for p in tap.partials)
+    assert total_w == 12.0
+
+
+def test_stale_upload_folds_downweighted_when_family_armed():
+    """With poly:0.5 armed, a round-(r-1) upload landing in round r folds
+    at weight s(1)*n = 2^-0.5 * n instead of being discarded; without a
+    family the same upload is dropped and counted."""
+    server, edge, tap, rounds_done = _edge_cell(
+        child_num=2, buffer_goal=1, staleness_weight="poly:0.5")
+    x = np.full(16, 1.0, np.float32)
+    # child 1 never lands in round 0 — the elastic flush closes the window
+    edge.comm.notify(_upload(2, 0, x))
+    edge.flush_window()
+    assert rounds_done == [0]
+    # round 1 now current at the edge; child 1's delayed round-0 upload
+    # folds down-weighted at s(1)*n instead of being discarded
+    edge.comm.notify(_upload(1, 0, x, n=4.0))
+    stale = tap.partials[-1]
+    w = float(stale.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
+    assert w == pytest.approx(2.0 ** -0.5 * 4.0)
+    assert stale.get(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE) == 0
+    assert edge.tier_counters()["stale_folds"] == 1
+
+    # no family: the same late leg is discarded, nothing emitted
+    server2, edge2, tap2, done2 = _edge_cell(child_num=2, buffer_goal=1)
+    edge2.comm.notify(_upload(2, 0, x))
+    edge2.flush_window()
+    assert done2 == [0]
+    n_emitted = len(tap2.partials)
+    edge2.comm.notify(_upload(1, 0, x))
+    assert len(tap2.partials) == n_emitted
+    assert edge2.tier_counters()["stale_uploads"] == 1
+
+
+def test_elastic_flush_emits_and_names_missing_children(caplog):
+    """flush_window on a half-filled window emits what the tier HAS as a
+    complete emission (the parent's barrier closes over this subtree) and
+    the warning names the children that never completed."""
+    server, edge, tap, rounds_done = _edge_cell(
+        child_num=3, buffer_goal=3, tier_timeout=30.0)
+    x = np.full(16, 0.25, np.float32)
+    edge.comm.notify(_upload(1, 0, x))
+    assert tap.partials == []
+    with caplog.at_level(logging.WARNING):
+        edge.flush_window()
+    assert len(tap.partials) == 1
+    out = tap.partials[0]
+    assert out.get(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE) == 1
+    assert float(out.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM)) == 4.0
+    assert edge.tier_counters()["elastic_emissions"] == 1
+    assert "[2, 3]" in caplog.text  # the missing children, by rank
+    # the flush closed the tier's contribution: the root's barrier saw one
+    # complete tier, so the round advanced
+    assert rounds_done == [0]
+    # a flush with NOTHING pending and no prior emission stays silent
+    assert edge.tier_counters()["emissions"] == 0  # window reset by round 1
+    edge.flush_window()
+    assert len(tap.partials) == 1
+
+
+def test_elastic_flush_zero_marker_after_mid_window_emissions():
+    """Everything already forwarded mid-window: the flush ships a
+    weight-0 zero partial purely to carry window_complete=1."""
+    server, edge, tap, rounds_done = _edge_cell(child_num=3, buffer_goal=1)
+    x = np.full(16, 0.25, np.float32)
+    edge.comm.notify(_upload(1, 0, x))
+    edge.comm.notify(_upload(2, 0, x))
+    assert len(tap.partials) == 2 and rounds_done == []
+    edge.flush_window()
+    assert len(tap.partials) == 3
+    marker = tap.partials[-1]
+    assert float(marker.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM)) == 0.0
+    assert marker.get(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE) == 1
+    assert rounds_done == [0]
+
+
+def test_duplicate_and_replay_guards():
+    """A child re-sending its round-r model is absorbed by the versioned
+    fold guard; a replayed (round, seq) partial at a parent tier is
+    absorbed by the window guard. Neither perturbs the tally."""
+    server, edge, tap, rounds_done = _edge_cell(child_num=2, buffer_goal=2)
+    x = np.full(16, 1.0, np.float32)
+    edge.comm.notify(_upload(1, 0, x))
+    edge.comm.notify(_upload(1, 0, x))  # duplicate leg
+    assert edge.tier_counters()["duplicate_uploads"] == 1
+    edge.comm.notify(_upload(2, 0, x))
+    assert rounds_done == [0]
+    assert float(tap.partials[-1].get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM)) \
+        == 8.0  # the duplicate never folded
+
+    # replay guard on the partial path: an interior edge over tier children
+    flat, desc = pack_pytree({"w": np.zeros(16, np.float32)})
+    up = InlineFabric(2)
+    mid = EdgeAggregatorManager(
+        up_comm=InlineCommManager(up, 1), up_rank=1,
+        down_comm=InlineCommManager(InlineFabric(2), 0), child_num=1,
+        leaf_base=0, leaf_total=1, client_num_in_total=1,
+        children_are_leaves=False,
+        async_config=EdgeAsyncConfig(buffer_goal=1), model_desc=desc)
+    mid.register_message_receive_handlers()
+    part = Message(TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, 1, 0)
+    part.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                    np.ones(16, np.float64).view(np.uint8))
+    part.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, 2.0)
+    part.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, 1)
+    part.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 0)
+    part.add_params(TreeMessage.MSG_ARG_KEY_PARTIAL_SEQ, 0)
+    part.add_params(TreeMessage.MSG_ARG_KEY_WINDOW_COMPLETE, 1)
+    mid.comm.notify(part)
+    mid.comm.notify(part)  # replayed leg, same (round, seq)
+    assert mid.tier_counters()["duplicate_uploads"] == 1
+
+
+# ---------------------------------------------------------------------------
+# encoded tier uplinks
+# ---------------------------------------------------------------------------
+
+
+def test_encoded_partial_roundtrip_and_ratio():
+    """encode_partial/decode_partial: the none codec is bit-exact on the
+    f64 accumulator; q8 reconstructs the partial to quantization error and
+    beats the >=4x interior-bytes bar at model_size 1000."""
+    import jax
+
+    from fedml_tpu.compress.aggregate import decode_partial, encode_partial
+    from fedml_tpu.comm.message import pack_encoded_update
+
+    rng = np.random.RandomState(3)
+    d = 1000
+    base = rng.randn(d)
+    acc = 3.0 * base + rng.randn(d) * 0.05
+    key = jax.random.key(0)
+
+    none = make_codec("none")
+    enc = encode_partial(acc, 3.0, None, none, key)
+    out = decode_partial(enc, 3.0, None, none)
+    np.testing.assert_array_equal(out, acc)
+
+    q8 = make_codec("q8")
+    enc = encode_partial(acc, 3.0, base, q8, key)
+    blob, edesc = pack_encoded_update(enc)
+    ratio = acc.nbytes / (blob.nbytes + len(edesc))
+    assert ratio >= 4.0, ratio
+    out = decode_partial(enc, 3.0, base, q8)
+    # quantization error is a few delta-domain quant steps (stochastic
+    # rounding), NOT acc-domain steps — the delta framing is what keeps
+    # the base mass exact
+    delta = acc - 3.0 * base
+    step = (delta.max() - delta.min()) / 255
+    assert np.max(np.abs(out - acc)) <= 4 * step
+    acc_step = (acc.max() - acc.min()) / 255
+    assert np.max(np.abs(out - acc)) < acc_step / 4
+
+
+def test_stale_delta_encoded_partial_always_discarded():
+    """A delta-framed stale partial rode an old round global the tier no
+    longer holds — discarded even with a staleness family armed."""
+    flat, desc = pack_pytree({"w": np.zeros(16, np.float32)})
+    q8 = make_codec("q8")
+    mid = EdgeAggregatorManager(
+        up_comm=InlineCommManager(InlineFabric(2), 1), up_rank=1,
+        down_comm=InlineCommManager(InlineFabric(2), 0), child_num=1,
+        leaf_base=0, leaf_total=1, client_num_in_total=1,
+        children_are_leaves=False,
+        async_config=EdgeAsyncConfig(buffer_goal=1,
+                                     staleness_weight="poly:0.5",
+                                     uplink_codec=q8),
+        model_desc=desc)
+    mid.register_message_receive_handlers()
+    mid._round = 2  # as if two parent syncs landed
+    part = Message(TreeMessage.MSG_TYPE_T2S_SEND_PARTIAL, 1, 0)
+    part.add_params(Message.MSG_ARG_KEY_ENCODED_UPDATE,
+                    np.zeros(4, np.uint8))
+    part.add_params(Message.MSG_ARG_KEY_ENCODED_DESC, "{}")
+    part.add_params(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM, 1.0)
+    part.add_params(TreeMessage.MSG_ARG_KEY_FOLD_COUNT, 1)
+    part.add_params(MyMessage.MSG_ARG_KEY_ROUND_IDX, 1)  # stale
+    part.add_params(TreeMessage.MSG_ARG_KEY_PARTIAL_SEQ, 0)
+    mid.comm.notify(part)
+    assert mid.tier_counters()["stale_uploads"] == 1
+    assert mid.tier_counters()["folds_total"] == 0
+
+
+# ---------------------------------------------------------------------------
+# per-tier defense
+# ---------------------------------------------------------------------------
+
+
+def test_defense_rejects_nonfinite_and_clips_overbound():
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+    server, edge, tap, rounds_done = _edge_cell(
+        child_num=3, buffer_goal=3,
+        defense=RobustDistConfig(rule="mean", norm_bound=1.0))
+    bad = np.full(16, np.nan, np.float32)
+    edge.comm.notify(_upload(1, 0, bad))
+    assert edge.tier_counters()["rejected_uploads"] == 1
+    assert edge.tier_counters()["folds_total"] == 0
+    huge = np.full(16, 100.0, np.float32)
+    edge.comm.notify(_upload(2, 0, huge))
+    assert edge.tier_counters()["clipped_uploads"] == 1
+    ok = np.full(16, 0.01, np.float32)
+    edge.comm.notify(_upload(3, 0, ok))
+    # window at 2/3 folds (the rejected upload never counted); flush closes
+    edge.flush_window()
+    assert rounds_done == [0]
+    out = tap.partials[-1]
+    # the clipped delta's norm is exactly the bound
+    acc = np.ascontiguousarray(
+        np.asarray(out.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
+    ).view(np.float64)
+    wsum = float(out.get(TreeMessage.MSG_ARG_KEY_WEIGHT_SUM))
+    mean_delta = acc / wsum  # global is zeros, so acc IS the delta mass
+    assert np.isfinite(mean_delta).all()
+    assert float(np.linalg.norm(acc)) <= 4.0 * 1.0 + 4.0 * np.linalg.norm(
+        ok.astype(np.float64)) + 1e-9
+
+
+def test_empty_round_error_names_tier_and_missing_children():
+    agg = TierAggregator(3, tier_label="rank=2 leaf_base=64")
+    agg.add_partial_result(0, np.zeros(4, np.float64), 1.0)
+    err = agg._empty_round_error()
+    assert isinstance(err, EmptyRoundError)
+    assert "rank=2 leaf_base=64" in str(err)
+    assert "[2, 3]" in str(err)  # the missing children, by rank
+    # and export_partial on a starved async window raises it
+    starved = TierAggregator(2, tier_label="rank=1 leaf_base=0")
+    with pytest.raises(EmptyRoundError, match="rank=1 leaf_base=0"):
+        starved.export_partial()
+
+
+# ---------------------------------------------------------------------------
+# transports
+# ---------------------------------------------------------------------------
+
+
+def test_shm_tree_matches_loopback_bitwise():
+    trainer, train = _lr_fixture(workers=4)
+    loop_final = run_tree_fedavg_loopback(
+        trainer, train, (2, 2), 2, 8, buffer_goal=2,
+        tier_uplink_codec="none")
+    shm_final = run_tree_fedavg_shm(
+        trainer, train, (2, 2), 2, 8, buffer_goal=2,
+        tier_uplink_codec="none")
+    for a, b in zip(_snap(loop_final), _snap(shm_final)):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_grpc_group_comm_allocates_disjoint_cell_ports():
+    pytest.importorskip("grpc")
+    from fedml_tpu.async_agg.tree import GrpcGroupComm
+
+    group = GrpcGroupComm(base_port=18890)
+    f1 = group((), 3)
+    f2 = group((0,), 3)
+    c = f1(0)
+    try:
+        assert c is not None
+    finally:
+        c.stop_receive_message()
+    assert group._next_port == 18896
+    assert f2 is not None
+
+
+# ---------------------------------------------------------------------------
+# churned cascade harness
+# ---------------------------------------------------------------------------
+
+
+def test_cascade_small_churned_hierarchy():
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+    rep = run_cascade(
+        (2, 2, 2), rounds=3, model_size=64, buffer_goal=2,
+        tier_staleness="poly:0.5", tier_uplink_codec="q8",
+        tier_defense=RobustDistConfig(rule="mean", norm_bound=10.0,
+                                      dp_stddev=1e-3, dp_seed=7),
+        population="speed=lognormal:0,0.5;dropout=0.2;jitter=uniform:0,0.1",
+    )
+    assert rep.tier_count == 6  # 2 + 4 edges
+    assert rep.uploads + rep.dropped_uploads == 3 * 8
+    assert rep.interior_uplink_bytes > 0
+    assert rep.max_tier_state_bytes <= 64 * (8 + 4 + 8) + 256  # O(model)
+    assert rep.elastic_emissions >= 0
+    assert all(np.isfinite(v) for v in
+               (rep.uploads_per_s, rep.elapsed_s))
+
+
+def test_cascade_rejects_churn_without_async_tiers():
+    with pytest.raises(ValueError, match="barrier-free"):
+        run_cascade((2, 2), rounds=1, model_size=16,
+                    population="dropout=0.5")
+
+
+def test_cascade_sync_matches_async_full_buffer():
+    """No churn: the cascade's sync-barrier arm and the async full-buffer
+    arm run the same folds, so the root models agree bit-for-bit (the
+    cascade-level rung of the identity ladder)."""
+    sync = run_cascade((2, 2), rounds=2, model_size=32, seed=5)
+    full = run_cascade((2, 2), rounds=2, model_size=32, seed=5,
+                       buffer_goal=2, tier_staleness="const")
+    assert sync.uploads == full.uploads == 8
+    assert full.interior_dense_bytes == sync.interior_dense_bytes
+
+
+# ---------------------------------------------------------------------------
+# 10^6-upload soak (acceptance arm; excluded from tier-1 via -m 'not slow')
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # ~3 min: 10^6 folds through 1056 defended tiers
+def test_cascade_soak_million_uploads_through_defended_tiers():
+    """3-tier fan-in-32 (32768 leaves, 1056 edge tiers): >= 10^6 simulated
+    client uploads through clip+DP defended, q8-compressed async edges
+    under a churned population trace — with O(model) resident state per
+    tier and bounded process RSS growth after warmup."""
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+
+    model_size = 1000
+    # 33 rounds x 32768 leaves = 1,081,344 attempts; ~5% churn drops still
+    # leave >= 10^6 DELIVERED uploads
+    rep = run_cascade(
+        (32, 32, 32), rounds=33, model_size=model_size, buffer_goal=32,
+        tier_staleness="poly:0.5", tier_uplink_codec="q8",
+        tier_defense=RobustDistConfig(rule="mean", norm_bound=50.0,
+                                      dp_stddev=1e-4, dp_seed=11),
+        population="speed=lognormal:0,0.5;dropout=0.05;jitter=uniform:0,0.1",
+    )
+    assert rep.uploads >= 1_000_000, rep.uploads
+    assert rep.tier_count == 32 + 32 * 32
+    # interior compression: q8 tier uplinks cut tier-to-tier bytes >= 4x
+    assert rep.interior_dense_bytes / rep.interior_uplink_bytes >= 4.0
+    # O(model) per tier: accumulator + stashed f32/f64 globals, not
+    # O(children) or O(uploads)
+    assert rep.max_tier_state_bytes <= model_size * (8 + 4 + 8) + 1024
+    # process growth after the warmup round stays far under O(leaves x
+    # model) = 131 MB per retained copy
+    assert rep.rss_delta_kb < 400_000, rep.rss_delta_kb
+    assert rep.clipped_uploads >= 0 and rep.stale_folds > 0
+
+
+# ---------------------------------------------------------------------------
+# CLI tree plane
+# ---------------------------------------------------------------------------
+
+
+def test_cli_tree_async_knobs_end_to_end():
+    """--server_mode tree with the barrier-free knobs, churn, retries and
+    heartbeats armed end-to-end through the entry point."""
+    from fedml_tpu.exp import main_fedavg
+
+    parser = main_fedavg.add_args(argparse.ArgumentParser())
+    args = main_fedavg.parse_with_config(parser, [
+        "--model", "lr", "--dataset", "synthetic_0.5_0.5",
+        "--backend", "loopback", "--client_num_in_total", "8",
+        "--client_num_per_round", "4", "--batch_size", "8",
+        "--comm_round", "2", "--frequency_of_the_test", "2", "--lr", "0.05",
+        "--server_mode", "tree", "--tree_fan_ins", "2,2",
+        "--buffer_goal", "2", "--staleness_weight", "poly:0.5",
+        "--tier_timeout", "5.0", "--tier_compressor", "q8",
+        "--population", "speed=lognormal:0,0.5;jitter=uniform:0,0.05",
+        "--send_retries", "1", "--heartbeat_interval", "0.2",
+    ])
+    history = main_fedavg.run(args)
+    assert len(history) == 2
+    assert np.isfinite(history[-1]["Test/Loss"])

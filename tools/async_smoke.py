@@ -72,7 +72,7 @@ def main(argv=None) -> int:
         )
         return snap(final), per_round, wire_stats()
 
-    def run_tree():
+    def run_tree(**kwargs):
         # the ordered fabric pins the LEAF tier's fold order (the only cell
         # with racing uploaders — the root has a single child)
         def make_group(path, world):
@@ -92,6 +92,7 @@ def main(argv=None) -> int:
             trainer, train, (1, WORKERS), ROUNDS, 8,
             on_round_done=lambda r, v: per_round.append((r, snap(v))),
             make_group_comm=make_group,
+            **kwargs,
         )
         return snap(final), per_round, wire_stats()
 
@@ -100,6 +101,18 @@ def main(argv=None) -> int:
         server_mode="async", buffer_goal=WORKERS, staleness_weight="const"
     )
     tree_final, tree_rounds, tree_stats = run_tree()
+    # async edge tier at buffer_goal == fan_in: the window fills exactly at
+    # the barrier, so the fold-on-arrival discipline degrades to the sync
+    # tree — and therefore to the flat server — bit-for-bit
+    atree_final, atree_rounds, atree_stats = run_tree(
+        buffer_goal=WORKERS, tier_staleness="const"
+    )
+    # encoded tier uplink, 'none' codec: the partial rides the codec plane
+    # (pack_encoded_update framing) but the payload is the raw f64
+    # accumulator itself — bit-identical to the raw-partial wire
+    enc_final, enc_rounds, enc_stats = run_tree(
+        buffer_goal=WORKERS, tier_uplink_codec="none"
+    )
 
     def assert_identical(arm_rounds, arm_final, arm: str):
         assert len(arm_rounds) == len(sync_rounds) == ROUNDS, (
@@ -119,6 +132,10 @@ def main(argv=None) -> int:
     assert_identical(async_rounds, async_final,
                      "async (barrier + unit staleness + full buffer)")
     assert_identical(tree_rounds, tree_final, "1-tier tree")
+    assert_identical(atree_rounds, atree_final,
+                     "async edge tier (buffer_goal == fan_in)")
+    assert_identical(enc_rounds, enc_final,
+                     "encoded tier uplink (none codec)")
 
     # encode-once ledgers. Flat (sync AND async-with-barrier): one
     # serialization per downlink fan-out (init + per-round sync/stop) plus
@@ -138,11 +155,22 @@ def main(argv=None) -> int:
     assert tree_stats["payload_serializations"] == expect_tree, (
         tree_stats, expect_tree
     )
+    # the async edge serializes exactly what the legacy edge does (one
+    # partial per window, one window per round at full buffer); the encoded
+    # arm frames the same sends through pack_encoded_update
+    assert atree_stats["payload_serializations"] == expect_tree, (
+        atree_stats, expect_tree
+    )
+    assert enc_stats["payload_serializations"] == expect_tree, (
+        enc_stats, expect_tree
+    )
 
     print(
         f"async smoke OK: {ROUNDS} rounds x {WORKERS} workers — "
         "async(full-buffer barrier) == sync streaming bit-for-bit, "
-        "1-tier tree == flat server bit-for-bit; payload serializations "
+        "1-tier tree == flat server bit-for-bit, async edge tier "
+        "(buffer_goal == fan_in) == flat bit-for-bit, none-codec encoded "
+        "tier uplink == raw f64 bit-for-bit; payload serializations "
         f"{async_stats['payload_serializations']} (async) / "
         f"{tree_stats['payload_serializations']} (tree, one extra tier) vs "
         f"{sync_stats['payload_serializations']} (sync)"
