@@ -320,7 +320,14 @@ def bench_trace_overhead(n_rounds: int = TRACE_PROBE_ROUNDS):
     overhead is the largest relative share (a heavy model would hide it).
     The disabled figure is the configuration every other bench number runs
     in — instrumentation with no tracer installed must cost ~nothing; the
-    enabled overhead is the price of recording. Returns probe metrics."""
+    enabled overhead is the price of recording.
+
+    The third arm probes the propagated wire context (docs/OBSERVABILITY.md
+    "Cross-rank causal tracing") on the path the sim loop never touches — a
+    loopback FedAvg run where an armed ``trace_wire`` stamps the context on
+    every send leg: tracing-off vs context-off (tracer only) vs context-on
+    (tracer + stamps). The stamp is one small header dict per message;
+    context-on over context-off targets <= 3%. Returns probe metrics."""
     import numpy as np
 
     import optax
@@ -370,6 +377,43 @@ def bench_trace_overhead(n_rounds: int = TRACE_PROBE_ROUNDS):
 
     disabled, _ = rps(False)
     enabled, tracer = rps(True)
+
+    # propagated-context arm: loopback FedAvg, where every uplink/downlink
+    # leg stamps MSG_ARG_KEY_TRACE_CTX once trace_wire is armed
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        run_distributed_fedavg_loopback,
+    )
+
+    W, wire_rounds = 2, 6
+    wpart = {i: np.arange(i * n_per, (i + 1) * n_per) for i in range(W)}
+    wtrain = FederatedArrays(
+        {"x": rng.rand(W * n_per, F).astype(np.float32),
+         "y": rng.randint(0, K, W * n_per).astype(np.int32)},
+        wpart,
+    )
+
+    def wire_rps(tracer_on: bool, ctx_on: bool) -> float:
+        best = 0.0
+        for _trial in range(3):
+            if tracer_on:
+                trace.install()
+            try:
+                t0 = time.perf_counter()
+                run_distributed_fedavg_loopback(
+                    trainer, wtrain, worker_num=W, round_num=wire_rounds,
+                    batch_size=B, seed=0, trace_wire=ctx_on,
+                )
+                dt = time.perf_counter() - t0
+            finally:
+                if tracer_on:
+                    trace.uninstall()
+            best = max(best, wire_rounds / dt)
+        return best
+
+    wire_rps(False, False)  # compile + warm the wire-path programs
+    wire_off = wire_rps(False, False)
+    ctx_off = wire_rps(True, False)
+    ctx_on = wire_rps(True, True)
     return {
         "trace_probe_rounds": n_rounds,
         "trace_disabled_rounds_per_sec": round(disabled, 3),
@@ -378,6 +422,13 @@ def bench_trace_overhead(n_rounds: int = TRACE_PROBE_ROUNDS):
             100.0 * (disabled - enabled) / disabled, 2
         ),
         "trace_events_per_round": round(len(tracer.events()) / n_rounds, 1),
+        "trace_wire_probe_rounds": wire_rounds,
+        "trace_wire_untraced_rounds_per_sec": round(wire_off, 3),
+        "trace_ctx_off_rounds_per_sec": round(ctx_off, 3),
+        "trace_ctx_on_rounds_per_sec": round(ctx_on, 3),
+        "trace_ctx_overhead_pct": round(
+            100.0 * (ctx_off - ctx_on) / ctx_off, 2
+        ),
     }
 
 

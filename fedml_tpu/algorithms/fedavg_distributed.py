@@ -785,9 +785,21 @@ class FedAvgServerManager(ServerManager):
             # eligible to rejoin later cohorts on contact.
             self._fanout_model(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT,
                                excluded, finished=True)
-        self._complete_round(expected_round)
+        self._complete_round(expected_round, timed_out=True)
 
-    def _complete_round(self, expected_round: int) -> None:
+    def _complete_round(self, expected_round: int,
+                        timed_out: bool = False) -> None:
+        # round/close span: aggregate + advance + next fan-out. On the
+        # all-received path it runs on the LAST upload's handler thread, so
+        # it nests inside that upload's comm/recv span — the causal link
+        # the critical-path analyzer (tools/trace_report.py) walks to name
+        # the gating client/tier; a timer-fired close carries timed_out=1
+        # and has no recv ancestor.
+        with trace.span("round/close", round=expected_round,
+                        timed_out=int(timed_out)):
+            self._complete_round_locked(expected_round)
+
+    def _complete_round_locked(self, expected_round: int) -> None:
         readmitted: list[int] = []
         with self._round_lock:
             if self.round_idx != expected_round:
@@ -1077,10 +1089,16 @@ class FedAvgClientManager(ClientManager):
             rng=np.random.RandomState(1000 + self._round),
         )
         batches = jax.tree.map(lambda v: jnp.asarray(v[0]), batches)
-        new_vars, _ = self._local_train(
-            variables, batches,
-            jax.random.key(self.rng_rank * 100003 + self._round),
-        )
+        # client/train span: the local-round compute between a sync's
+        # arrival and the upload's send — nested (same handler thread)
+        # under the sync's comm/recv span, so the merged cross-rank trace
+        # links round/close -> upload send -> this span -> sync fan-out
+        with trace.span("client/train", rank=self.rank, round=self._round,
+                        client_idx=client_idx):
+            new_vars, _ = self._local_train(
+                variables, batches,
+                jax.random.key(self.rng_rank * 100003 + self._round),
+            )
         self._round += 1
         out = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         self._fill_upload(out, new_vars, variables)
@@ -1298,31 +1316,45 @@ def init_template(trainer: ClientTrainer, train_arrays: dict, batch_size: int,
     return template, flat, desc
 
 
-def run_manager_protocol(server, clients, join_timeout: float = 30.0) -> None:
+def run_manager_protocol(server, clients, join_timeout: float = 30.0,
+                         client_lanes: list[str] | None = None,
+                         server_lane: str | None = None) -> None:
     """Shared run harness: client managers in daemon threads, the server's
     receive loop on the caller thread, graceful join. Used by distributed
     FedAvg, TurboAggregate, and cross-silo. If the server's loop dies (e.g.
     an injected crash, comm/faults.py), the client transports are stopped
     so their threads unblock before the error propagates — a crashed server
-    must not leak parked client threads into the next (restarted) run."""
-    # client threads inherit the caller's job binding (obs/jobscope.py):
-    # under the multi-tenant runner a job's clients emit into ITS job-scoped
-    # registry/tracer; single-job runs get the target back unchanged
-    threads = [threading.Thread(target=jobscope.wrap_target(c.run),
-                                daemon=True) for c in clients]
+    must not leak parked client threads into the next (restarted) run.
+
+    ``client_lanes``/``server_lane`` bind each manager's thread to a
+    per-rank lane (obs/jobscope.py) so a ``trace.lane_traces`` harness
+    captures one span stream per rank — the in-process form of per-process
+    ``--trace_dir`` files that ``tools/trace_merge.py`` merges."""
+    # client threads inherit the caller's job binding (obs/jobscope.py)
+    # unless an explicit lane is given: under the multi-tenant runner a
+    # job's clients emit into ITS job-scoped registry/tracer; single-job
+    # runs get the target back unchanged
+    threads = [
+        threading.Thread(
+            target=jobscope.wrap_target(
+                c.run, job=client_lanes[i] if client_lanes else None),
+            daemon=True)
+        for i, c in enumerate(clients)
+    ]
     for t in threads:
         t.start()
-    server.register_message_receive_handlers()
-    server.send_init_msg()
-    try:
-        server.comm.handle_receive_message()  # blocks until the protocol finishes
-    except BaseException:
-        for c in clients:
-            try:
-                c.comm.stop_receive_message()
-            except Exception:  # noqa: BLE001 — best-effort unblock
-                pass
-        raise
+    with jobscope.bound(server_lane):
+        server.register_message_receive_handlers()
+        server.send_init_msg()
+        try:
+            server.comm.handle_receive_message()  # blocks until the protocol finishes
+        except BaseException:
+            for c in clients:
+                try:
+                    c.comm.stop_receive_message()
+                except Exception:  # noqa: BLE001 — best-effort unblock
+                    pass
+            raise
     for t in threads:
         t.join(timeout=join_timeout)
 
@@ -1364,6 +1396,8 @@ def run_distributed_fedavg(
     staleness_weight: str = "const",
     async_stats: dict | None = None,
     fleet_stats: dict | None = None,
+    trace_lanes: str | None = None,
+    trace_wire: bool = False,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -1692,6 +1726,20 @@ def run_distributed_fedavg(
         for c in clients:
             c.population_profile = population.profiles.get(c.rank)
 
+    # cross-rank causal tracing (docs/OBSERVABILITY.md): ``trace_wire``
+    # arms the context stamp on every rank's transport (the explicit
+    # per-manager opt-in — same discipline as fleet_telemetry above);
+    # ``trace_lanes`` additionally installs one job-scoped tracer per rank
+    # lane and exports trace_rank<N>.jsonl files for tools/trace_merge.py
+    client_lanes = None
+    if trace_lanes is not None:
+        trace_wire = True
+        client_lanes = [f"rank{c.rank}" for c in clients]
+    if trace_wire:
+        server.comm.trace_wire = True
+        for c in clients:
+            c.comm.trace_wire = True
+
     from fedml_tpu.comm.retry import retry_stats
 
     retries_before = retry_stats()["retries"]
@@ -1709,7 +1757,13 @@ def run_distributed_fedavg(
     if fleet_stats is not None and registry.get() is None:
         _installed_registry = registry.install()
     try:
-        run_manager_protocol(server, clients)
+        if trace_lanes is not None:
+            with trace.lane_traces(trace_lanes, ["rank0"] + client_lanes):
+                run_manager_protocol(server, clients,
+                                     client_lanes=client_lanes,
+                                     server_lane="rank0")
+        else:
+            run_manager_protocol(server, clients)
     finally:
         for hb in heartbeats:
             hb.stop()

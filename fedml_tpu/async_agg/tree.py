@@ -52,6 +52,7 @@ from fedml_tpu.comm.message import (
     unpack_pytree,
 )
 from fedml_tpu.core import rng as rnglib
+from fedml_tpu.obs import jobscope
 from fedml_tpu.obs import metrics as metricslib
 from fedml_tpu.obs import registry
 from fedml_tpu.obs import trace
@@ -427,8 +428,11 @@ class EdgeAggregatorManager(DistributedManager):
     def run(self) -> None:
         self.register_message_receive_handlers()
         self._up_thread = threading.Thread(
-            target=self.up_comm.handle_receive_message, daemon=True,
-            name=f"edge-up-r{self.up_rank}",
+            # the up-fabric loop inherits this tier's job/lane binding
+            # (obs/jobscope.py) so parent-sync recv spans land in the SAME
+            # per-tier tracer as the down-fabric folds
+            target=jobscope.wrap_target(self.up_comm.handle_receive_message),
+            daemon=True, name=f"edge-up-r{self.up_rank}",
         )
         self._up_thread.start()
         self.comm.handle_receive_message()  # down fabric, caller thread
@@ -440,11 +444,26 @@ class EdgeAggregatorManager(DistributedManager):
     def _send_up(self, msg: Message) -> None:
         policy = getattr(self.up_comm, "retry_policy", None)
         if policy is None:
-            self.up_comm.send_message(msg)
+            send = lambda: self.up_comm.send_message(msg)  # noqa: E731
         else:
-            policy.run(lambda: self.up_comm.send_message(msg),
-                       on_retry=self._note_retry,
-                       dst=msg.get_receiver_id(), msg_type=msg.get_type())
+            send = lambda: policy.run(  # noqa: E731
+                lambda: self.up_comm.send_message(msg),
+                on_retry=self._note_retry,
+                dst=msg.get_receiver_id(), msg_type=msg.get_type())
+        tracer = trace.get()
+        if tracer is None:
+            send()
+            return
+        # the uplink leg bypasses DistributedManager.send_message (that
+        # layer is bound to the DOWN fabric), so it opens its own comm/send
+        # span and stamps the trace context here — the wire hop the merged
+        # trace walks from the root's fold back into this tier
+        with tracer.span("comm/send", msg_type=msg.get_type(),
+                         sender=self.up_rank,
+                         receiver=msg.get_receiver_id(),
+                         bytes=msg.payload_nbytes()):
+            self.up_comm.stamp_trace_ctx(msg)
+            send()
 
     # -- downlink: parent sync re-broadcast ----------------------------------
 
@@ -894,7 +913,10 @@ class EdgeAggregatorManager(DistributedManager):
         if (self._async.tier_timeout is None or self._drained
                 or self._tier_timer is not None):
             return
-        t = threading.Timer(self._async.tier_timeout, self._tier_timed_out,
+        # timer fires on its own thread: inherit this tier's job/lane
+        # binding so its flush spans land in the tier's tracer
+        t = threading.Timer(self._async.tier_timeout,
+                            jobscope.wrap_target(self._tier_timed_out),
                             args=(self._round,))
         t.daemon = True
         t.start()
@@ -1240,7 +1262,10 @@ class TreeFedAvgServerManager(FedAvgServerManager):
             if not all_received and self.round_timeout is not None:
                 if self._round_timer is None:
                     self._round_timer = threading.Timer(
-                        self.round_timeout, self._round_timed_out,
+                        self.round_timeout,
+                        # inherit the root's job/lane binding (same
+                        # discipline as the flat server's round timer)
+                        jobscope.wrap_target(self._round_timed_out),
                         args=(current,),
                     )
                     self._round_timer.daemon = True
@@ -1348,6 +1373,8 @@ def run_tree_fedavg(
     population=None,
     fault_seed: int = 0,
     tier_stats: dict | None = None,
+    trace_lanes: str | None = None,
+    trace_wire: bool = False,
 ):
     """End-to-end hierarchical FedAvg: root -> edge tiers -> leaf clients,
     one comm group (fabric) per parent/children cell. ``make_group_comm
@@ -1377,7 +1404,13 @@ def run_tree_fedavg(
     transports wrap in the seeded fault machinery by GLOBAL leaf rank, so
     one churn trace drives the whole hierarchy) compose with everything
     above. ``tier_stats`` (a caller dict) receives per-edge counter dicts
-    plus Comm/TierUplink* byte totals.
+    plus Comm/TierUplink* byte totals. ``trace_lanes`` (a directory path)
+    installs one per-node tracer — lanes ``root`` / ``edge{i}`` (creation
+    order) / ``leaf{r}`` (GLOBAL leaf rank) — exports each node's causal
+    trace as ``trace_<lane>.jsonl`` for tools/trace_merge.py, and arms
+    ``trace_wire`` on every cell comm so contexts propagate across the
+    tiers (docs/OBSERVABILITY.md "Cross-rank causal tracing"); setting
+    ``trace_wire`` alone stamps contexts without installing tracers.
     Returns the final global variables (the flat server's return shape)."""
     topo = topology if isinstance(topology, TreeTopology) else TreeTopology(tuple(topology))
     if isinstance(tier_uplink_codec, str):
@@ -1565,40 +1598,74 @@ def run_tree_fedavg(
             HeartbeatSender(m.up_comm, m.up_rank, heartbeat_interval)
             for m in managers if isinstance(m, EdgeAggregatorManager)
         ]
-    threads = [threading.Thread(target=m.run, daemon=True) for m in managers]
-    for t in threads:
-        t.start()
-    for hb in heartbeats:
-        hb.start()
-    server.register_message_receive_handlers()
-    _installed_registry = None
-    if fleet_stats is not None and registry.get() is None:
-        _installed_registry = registry.install()
+    # cross-rank causal tracing: one lane (= one tracer, one JSONL) per
+    # tree node. Edge lanes number in creation (depth-first) order; leaf
+    # lanes carry the GLOBAL leaf rank already threaded for the rng chain.
+    lane_of: dict[int, str] = {}
+    if trace_lanes is not None:
+        trace_wire = True
+        _ei = 0
+        for m in managers:
+            if isinstance(m, EdgeAggregatorManager):
+                lane_of[id(m)] = f"edge{_ei}"
+                _ei += 1
+            else:
+                lane_of[id(m)] = f"leaf{m.rng_rank}"
+    if trace_wire:
+        # every cell comm stamps outgoing headers (fault wrappers inherit
+        # the flag from BaseCommunicationManager, so faulted leaves stamp
+        # through their wrapper)
+        server.comm.trace_wire = True
+        for m in managers:
+            m.comm.trace_wire = True
+            if isinstance(m, EdgeAggregatorManager):
+                m.up_comm.trace_wire = True
+    _lane_traces = None
+    if trace_lanes is not None:
+        _lane_traces = trace.lane_traces(
+            trace_lanes, ["root"] + [lane_of[id(m)] for m in managers])
+        _lane_traces.__enter__()
+    threads = [threading.Thread(
+        target=jobscope.wrap_target(m.run, job=lane_of.get(id(m))),
+        daemon=True) for m in managers]
     try:
-        server.send_init_msg()
-        try:
-            server.comm.handle_receive_message()
-        except BaseException:
-            for m in managers:
-                try:
-                    m.finish()
-                except Exception:  # noqa: BLE001 — best-effort unblock
-                    pass
-            raise
-    finally:
+        for t in threads:
+            t.start()
         for hb in heartbeats:
-            hb.stop()
-        if fleet_stats is not None:
-            if fleet is not None:
-                fleet_stats["totals"] = fleet.snapshot()
-            reg = registry.get()
-            if reg is not None:
-                fleet_stats["registry"] = reg.snapshot()
-            if _installed_registry is not None \
-                    and registry.get() is _installed_registry:
-                registry.uninstall()
-    for t in threads:
-        t.join(timeout=join_timeout)
+            hb.start()
+        server.register_message_receive_handlers()
+        _installed_registry = None
+        if fleet_stats is not None and registry.get() is None:
+            _installed_registry = registry.install()
+        try:
+            with jobscope.bound("root" if trace_lanes is not None else None):
+                server.send_init_msg()
+                try:
+                    server.comm.handle_receive_message()
+                except BaseException:
+                    for m in managers:
+                        try:
+                            m.finish()
+                        except Exception:  # noqa: BLE001 — best-effort unblock
+                            pass
+                    raise
+        finally:
+            for hb in heartbeats:
+                hb.stop()
+            if fleet_stats is not None:
+                if fleet is not None:
+                    fleet_stats["totals"] = fleet.snapshot()
+                reg = registry.get()
+                if reg is not None:
+                    fleet_stats["registry"] = reg.snapshot()
+                if _installed_registry is not None \
+                        and registry.get() is _installed_registry:
+                    registry.uninstall()
+        for t in threads:
+            t.join(timeout=join_timeout)
+    finally:
+        if _lane_traces is not None:
+            _lane_traces.__exit__(None, None, None)
     if comm_stats is not None and server.accountant is not None:
         comm_stats["totals"] = server.accountant.totals()
     if tier_stats is not None or comm_stats is not None:

@@ -47,6 +47,14 @@ class BaseCommunicationManager(abc.ABC):
         # backend — including a fault-injection wrapper, whose seeded draws
         # then re-roll per attempt.
         self.retry_policy = retry_policy
+        # cross-rank causal tracing opt-in (docs/OBSERVABILITY.md
+        # "Cross-rank causal tracing"): when armed by the run harness
+        # (same explicit-flag discipline as ``fleet_telemetry`` — never
+        # inferred from a tracer being installed), the send/broadcast paths
+        # stamp MSG_ARG_KEY_TRACE_CTX on outgoing headers and the receive
+        # path links comm/recv spans to the sender's context. Off (the
+        # default), wire bytes are identical to a pre-tracing build.
+        self.trace_wire = False
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -54,16 +62,41 @@ class BaseCommunicationManager(abc.ABC):
     def remove_observer(self, observer: Observer) -> None:
         self._observers.remove(observer)
 
+    def stamp_trace_ctx(self, msg: "Message") -> None:
+        """Stamp the calling thread's trace context on ``msg`` when the
+        ``trace_wire`` opt-in is armed and a tracer resolves; no-op (and
+        zero wire-byte change) otherwise. Callers stamp INSIDE their
+        comm/send span so the context's span id names that send leg."""
+        if not self.trace_wire:
+            return
+        ctx = trace.wire_ctx(origin=msg.get_sender_id())
+        if ctx is not None:
+            from fedml_tpu.comm.message import Message
+
+            msg.add_params(Message.MSG_ARG_KEY_TRACE_CTX, ctx)
+
     def notify(self, msg: "Message") -> None:
         tracer = trace.get()
         if tracer is None:  # disabled path: skip the payload-size walk too
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
             return
+        from fedml_tpu.comm.message import Message
+
+        ctx = msg.get(Message.MSG_ARG_KEY_TRACE_CTX)
+        ctx_args = {}
+        if isinstance(ctx, dict):
+            # the incoming context opens this recv as a causal child of the
+            # sender's send span: trace_merge matches (ctx_lane, ctx_span)
+            # to that span's (lane, span_id) across per-rank files
+            ctx_args = {"ctx_span": ctx.get("span"),
+                        "ctx_lane": ctx.get("lane"),
+                        "ctx_rank": ctx.get("rank"),
+                        "ctx_sent_at": ctx.get("sent_at")}
         with tracer.span("comm/recv", msg_type=msg.get_type(),
                          sender=msg.get_sender_id(),
                          receiver=msg.get_receiver_id(),
-                         bytes=msg.payload_nbytes()):
+                         bytes=msg.payload_nbytes(), **ctx_args):
             for obs in list(self._observers):
                 obs.receive_message(msg.get_type(), msg)
 
@@ -100,6 +133,16 @@ class BaseCommunicationManager(abc.ABC):
             policy = self.retry_policy
             with trace.span("comm/send", msg_type=msg_type, sender=sender,
                             receiver=dst, bytes=nbytes, broadcast=1):
+                if self.trace_wire:
+                    # stamped inside the span so the context names THIS
+                    # leg; rides the header-only override path (the shared
+                    # payload segments stay one serialization)
+                    ctx = trace.wire_ctx(origin=sender)
+                    if ctx is not None:
+                        from fedml_tpu.comm.message import Message
+
+                        ov = dict(ov) if ov else {}
+                        ov[Message.MSG_ARG_KEY_TRACE_CTX] = ctx
                 if policy is None:
                     self._send_framed(frame, dst, ov)
                 else:

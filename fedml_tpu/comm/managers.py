@@ -113,6 +113,12 @@ class DistributedManager(Observer):
                          sender=self.rank,
                          receiver=msg.get_receiver_id(),
                          bytes=msg.payload_nbytes()):
+            # cross-rank causal tracing: the transport stamps the outgoing
+            # header with this send span's context when its trace_wire
+            # opt-in is armed (no-op, zero wire bytes otherwise)
+            stamp = getattr(self.comm, "stamp_trace_ctx", None)
+            if stamp is not None:
+                stamp(msg)
             send()
 
     def broadcast_message(self, msg: Message, receiver_ids: list[int],

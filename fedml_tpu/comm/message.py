@@ -120,6 +120,16 @@ class Message:
     # job id routes to the implicit default job, so a single-job run's wire
     # bytes and behavior are unchanged (tools/multijob_smoke.py).
     MSG_ARG_KEY_JOB_ID = "job_id"
+    # cross-rank causal tracing (fedml_tpu/obs/trace.py wire_ctx,
+    # docs/OBSERVABILITY.md "Cross-rank causal tracing"): the sender's open
+    # span id + ancestor chain + lane/rank + send wall time, stamped by the
+    # comm send/broadcast paths ONLY behind a manager's explicit
+    # ``trace_wire`` opt-in. Header-only JSON scalars (never an array
+    # segment), OPTIONAL (absent = zero wire overhead, bytes identical to a
+    # pre-tracing run), and never read by the aggregation path — the
+    # receive side only attaches it to its comm/recv span so
+    # tools/trace_merge.py can link N per-rank traces causally.
+    MSG_ARG_KEY_TRACE_CTX = "trace_ctx"
 
     def __init__(self, msg_type: int = 0, sender_id: int = 0, receiver_id: int = 0):
         self.msg_params: dict[str, Any] = {

@@ -48,6 +48,7 @@ or, scoped (the ``--trace_dir`` entry-point wiring)::
 
 from __future__ import annotations
 
+import itertools
 import json
 import threading
 import time
@@ -56,12 +57,19 @@ from typing import Any
 
 __all__ = [
     "Tracer", "install", "uninstall", "get", "enabled",
-    "span", "event", "counter", "gauge", "trace_to",
-    "CHROME_TRACE_NAME", "JSONL_TRACE_NAME",
+    "span", "event", "counter", "gauge", "trace_to", "wire_ctx",
+    "lane_traces",
+    "CHROME_TRACE_NAME", "JSONL_TRACE_NAME", "META_EVENT_NAME",
 ]
 
 JSONL_TRACE_NAME = "trace.jsonl"
 CHROME_TRACE_NAME = "trace.chrome.json"
+META_EVENT_NAME = "trace/meta"
+
+# ancestors carried in a wire trace context (comm/base.py stamping): enough
+# to reconstruct the enclosing handler/broadcast chain at the receiver
+# without letting deeply-nested rounds grow the header unboundedly
+MAX_CTX_CHAIN = 8
 
 
 class _NullSpan:
@@ -80,9 +88,14 @@ _NULL_SPAN = _NullSpan()
 
 
 class _Span:
-    """One live span; created by :meth:`Tracer.span`."""
+    """One live span; created by :meth:`Tracer.span`.
 
-    __slots__ = ("_tracer", "_name", "_attrs", "_t0")
+    On enter it is assigned a tracer-unique ``span_id`` and pushed on the
+    calling thread's open-span stack (the stack top is its ``parent_id``),
+    so every recorded span carries a causal parent link and
+    :func:`wire_ctx` can snapshot the open chain for the wire."""
+
+    __slots__ = ("_tracer", "_name", "_attrs", "_t0", "span_id", "_open")
 
     def __init__(self, tracer: "Tracer", name: str, attrs: dict):
         self._tracer = tracer
@@ -90,12 +103,39 @@ class _Span:
         self._attrs = attrs
 
     def __enter__(self) -> "_Span":
-        self._t0 = self._tracer._clock()
+        tracer = self._tracer
+        self._t0 = tracer._clock()
+        stack = tracer._stack()
+        self.span_id = next(tracer._ids)
+        self._open = {
+            "name": self._name, "ts": tracer._us(self._t0),
+            "tid": tracer._tid(), "span_id": self.span_id,
+            "parent_id": stack[-1]["span_id"] if stack else None,
+            "attrs": self._attrs,
+        }
+        stack.append(self._open)
         return self
 
     def __exit__(self, *exc) -> bool:
-        self._tracer.add_span(self._name, self._t0, self._tracer._clock(),
-                              **self._attrs)
+        tracer = self._tracer
+        t_end = tracer._clock()
+        stack = tracer._stack()
+        if stack and stack[-1] is self._open:
+            stack.pop()
+        else:  # out-of-order exit (shouldn't happen): drop just this entry
+            try:
+                stack.remove(self._open)
+            except ValueError:
+                pass
+        rec = {
+            "name": self._name, "ph": "X", "ts": self._open["ts"],
+            "dur": max(tracer._us(t_end) - self._open["ts"], 0.0),
+            "tid": self._open["tid"],
+            "args": {**self._attrs, "span_id": self.span_id},
+        }
+        if self._open["parent_id"] is not None:
+            rec["args"]["parent_id"] = self._open["parent_id"]
+        tracer._record(rec)
         return False
 
 
@@ -120,11 +160,20 @@ class Tracer:
     DEFAULT_MAX_EVENTS = 2_000_000
     DROPPED_EVENT_NAME = "trace/dropped_events"
 
-    def __init__(self, max_events: int | None = None):
+    def __init__(self, max_events: int | None = None,
+                 lane: str | None = None):
         from collections import deque
 
         self._clock = time.perf_counter
         self._t0 = self._clock()
+        # wall-clock anchor for this tracer's t=0 (exported as metadata):
+        # lets tools/trace_merge.py coarsely align lanes that never
+        # exchanged a message, before send<->recv pairs refine the offset
+        self.wall0 = time.time()
+        # lane label identifying this tracer's process/rank in a merged
+        # multi-rank trace; rides outgoing wire contexts so the receive
+        # side can name its causal origin
+        self.lane = lane
         self._lock = threading.Lock()
         self._max_events = (self.DEFAULT_MAX_EVENTS if max_events is None
                             else int(max_events))
@@ -132,6 +181,19 @@ class Tracer:
         self.dropped = 0  # guarded-by: _lock
         self._thread_ids: dict[int, int] = {}
         self._thread_names: dict[int, str] = {}
+        self._ids = itertools.count(1)  # span ids; count.__next__ is atomic
+        self._local = threading.local()
+        # thread ident -> that thread's open-span stack, registered on the
+        # thread's first span so exporters can surface still-open spans
+        self._open_stacks: dict[int, list] = {}  # guarded-by: _lock
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+            with self._lock:
+                self._open_stacks[threading.get_ident()] = st
+        return st
 
     def _record(self, rec: dict) -> None:
         with self._lock:
@@ -166,14 +228,33 @@ class Tracer:
                  **attrs: Any) -> None:
         """Record an already-timed span (``time.perf_counter`` endpoints) —
         the manual-timing API for callers like RoundTimer that measured the
-        interval themselves."""
+        interval themselves. Parented under the calling thread's innermost
+        open span, like a context-manager span would be."""
+        stack = self._stack()
         rec = {
             "name": name, "ph": "X", "ts": self._us(t_start),
             "dur": max((t_end - t_start) * 1e6, 0.0), "tid": self._tid(),
+            "args": {**attrs, "span_id": next(self._ids)},
         }
-        if attrs:
-            rec["args"] = attrs
+        if stack:
+            rec["args"]["parent_id"] = stack[-1]["span_id"]
         self._record(rec)
+
+    def current_ctx(self, origin: int | None = None) -> dict:
+        """The calling thread's wire trace context: innermost open span id,
+        its ancestor chain (inner-first, capped), this tracer's lane label,
+        the sender rank, and the send wall time — the header dict
+        ``comm/base.py`` stamps under ``MSG_ARG_KEY_TRACE_CTX``."""
+        stack = self._stack()
+        ctx: dict[str, Any] = {"rank": origin, "sent_at": time.time()}
+        if self.lane is not None:
+            ctx["lane"] = self.lane
+        if stack:
+            ctx["span"] = stack[-1]["span_id"]
+            chain = [s["span_id"] for s in stack[-2::-1]]
+            if chain:
+                ctx["chain"] = chain[:MAX_CTX_CHAIN]
+        return ctx
 
     def event(self, name: str, **attrs: Any) -> None:
         """Record an instant event (a point-in-time marker)."""
@@ -219,11 +300,44 @@ class Tracer:
         with self._lock:
             return dict(self._thread_names)
 
+    def open_spans(self) -> list[dict]:
+        """Spans entered but not yet exited at call time, as Chrome ``B``
+        (begin) records — a span a crash or hang left unterminated exports
+        open-ended instead of vanishing. Perfetto renders an unmatched
+        ``B`` as running to the end of the trace; tools/trace_report.py
+        flags it the same way."""
+        with self._lock:
+            stacks = [list(st) for st in self._open_stacks.values()]
+        recs = []
+        for stack in stacks:
+            for s in stack:
+                args = {**s["attrs"], "span_id": s["span_id"], "open": True}
+                if s["parent_id"] is not None:
+                    args["parent_id"] = s["parent_id"]
+                recs.append({"name": s["name"], "ph": "B", "ts": s["ts"],
+                             "tid": s["tid"], "args": args})
+        return recs
+
+    def _meta_records(self) -> list[dict]:
+        """Lane/wall-clock metadata + thread names, for the JSONL export:
+        tools/trace_merge.py reads these to label each per-rank lane and to
+        anchor lanes with no send<->recv pair on the wall clock."""
+        meta = [{
+            "name": META_EVENT_NAME, "ph": "M", "ts": 0.0, "tid": 0,
+            "args": {"wall0": self.wall0, "lane": self.lane},
+        }]
+        for tid, tname in sorted(self.thread_names().items()):
+            meta.append({"name": "thread_name", "ph": "M", "ts": 0.0,
+                         "tid": tid, "args": {"name": tname}})
+        return meta
+
     def export_jsonl(self, path: str | Path) -> Path:
-        """One event per line, same records as the Chrome export."""
+        """One event per line, same records as the Chrome export, prefixed
+        with ``M`` metadata lines (lane label, wall-clock anchor, thread
+        names) and suffixed with any still-open spans."""
         path = Path(path)
         path.parent.mkdir(parents=True, exist_ok=True)
-        recs = self.events()
+        recs = self._meta_records() + self.events() + self.open_spans()
         dropped = self._dropped_record()
         if dropped is not None:
             recs.append(dropped)
@@ -240,12 +354,12 @@ class Tracer:
         path.parent.mkdir(parents=True, exist_ok=True)
         meta = [
             {"name": "process_name", "ph": "M", "pid": self.PID, "tid": 0,
-             "args": {"name": "fedml_tpu"}},
+             "args": {"name": self.lane or "fedml_tpu"}},
         ]
         for tid, tname in sorted(self.thread_names().items()):
             meta.append({"name": "thread_name", "ph": "M", "pid": self.PID,
                          "tid": tid, "args": {"name": tname}})
-        recs = self.events()
+        recs = self.events() + self.open_spans()
         dropped = self._dropped_record()
         if dropped is not None:
             recs.append(dropped)
@@ -254,6 +368,7 @@ class Tracer:
                 {"pid": self.PID, **rec} for rec in recs
             ],
             "displayTimeUnit": "ms",
+            "traceMeta": {"wall0": self.wall0, "lane": self.lane},
         }
         if dropped is not None:
             payload["droppedEvents"] = int(dropped["args"]["value"])
@@ -354,6 +469,16 @@ def counter(name: str, value: float, **attrs: Any) -> None:
 gauge = counter
 
 
+def wire_ctx(origin: int | None = None) -> dict | None:
+    """The calling thread's wire trace context on the resolved tracer, or
+    None when no tracer is installed — the value ``comm/base.py`` stamps
+    under ``Message.MSG_ARG_KEY_TRACE_CTX`` when a manager's ``trace_wire``
+    opt-in is armed. None means: do not stamp, keep the wire byte-identical
+    to an untraced run."""
+    t = get()
+    return t.current_ctx(origin) if t is not None else None
+
+
 def run_traced(run_fn, args):
     """Entry-point seam for the ``--trace_dir`` flag: run ``run_fn(args)``
     under :class:`trace_to` when ``args.trace_dir`` is set, plain otherwise.
@@ -376,6 +501,36 @@ def add_cli_flag(parser):
              "read-only, results are unchanged",
     )
     return parser
+
+
+class lane_traces:
+    """Context manager: install one job-scoped :class:`Tracer` per lane
+    label and export each as ``trace_<lane>.jsonl`` into ``trace_dir`` on
+    exit — the in-process multi-rank tracing harness the loopback/shm run
+    harnesses use (a real multi-process deployment instead passes each
+    process its own ``--trace_dir`` and merges the per-process files).
+    Threads are routed to their lane's tracer by binding them with
+    ``jobscope`` (obs/jobscope.py); ``tools/trace_merge.py`` merges the
+    exported files into one Perfetto trace."""
+
+    def __init__(self, trace_dir: str | Path, lanes: list[str]):
+        self.trace_dir = Path(trace_dir)
+        self.lanes = list(lanes)
+        self.tracers: dict[str, Tracer] = {}
+        self.paths: dict[str, Path] = {}
+
+    def __enter__(self) -> "lane_traces":
+        for lane in self.lanes:
+            self.tracers[lane] = install_job(lane, Tracer(lane=lane))
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        for lane in self.lanes:
+            uninstall_job(lane)
+            self.paths[lane] = self.tracers[lane].export_jsonl(
+                self.trace_dir / f"trace_{lane}.jsonl"
+            )
+        return False
 
 
 class trace_to:

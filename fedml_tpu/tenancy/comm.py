@@ -197,6 +197,13 @@ class JobServerComm(BaseCommunicationManager):
         policy = self.retry_policy
         with trace.span("comm/send", msg_type=msg_type, sender=sender,
                         receiver=dst, bytes=nbytes, broadcast=1):
+            if self.trace_wire:
+                # same per-leg header-only ride as comm/base.py send_one:
+                # the shared payload segments stay one serialization
+                ctx = trace.wire_ctx(origin=sender)
+                if ctx is not None:
+                    ov = dict(ov) if ov else {}
+                    ov[Message.MSG_ARG_KEY_TRACE_CTX] = ctx
             if policy is None:
                 self._endpoint._send_framed(frame, dst, ov)
             else:
@@ -221,8 +228,20 @@ class JobServerComm(BaseCommunicationManager):
                 for obs in list(self._observers):
                     obs.receive_message(item.get_type(), item)
                 continue
+            # the shared endpoint's comm/recv fires on the UNBOUND router
+            # thread (no per-job tracer resolves there), so the causal link
+            # to the sender's context attaches here — the first span the
+            # message produces in the job's own lane
+            ctx = item.get(Message.MSG_ARG_KEY_TRACE_CTX)
+            ctx_args = {}
+            if isinstance(ctx, dict):
+                ctx_args = {"ctx_span": ctx.get("span"),
+                            "ctx_lane": ctx.get("lane"),
+                            "ctx_rank": ctx.get("rank"),
+                            "ctx_sent_at": ctx.get("sent_at")}
             with tracer.span("tenancy/dispatch", msg_type=item.get_type(),
-                             sender=item.get_sender_id(), job=self._key):
+                             sender=item.get_sender_id(), job=self._key,
+                             **ctx_args):
                 for obs in list(self._observers):
                     obs.receive_message(item.get_type(), item)
 

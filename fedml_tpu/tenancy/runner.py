@@ -75,6 +75,7 @@ def run_multi_job(
     fabric: LoopbackFabric | None = None,
     out_dir: str | None = None,
     join_timeout: float | None = None,
+    trace_dir: str | None = None,
 ) -> dict[str, JobResult]:
     """Run every job concurrently over one shared wire; returns
     ``{job name: JobResult}``. ``fabric`` defaults to a fresh
@@ -82,7 +83,12 @@ def run_multi_job(
     variant (tenancy/comm.py ``MultiJobOrderedUplinkFabric``) to pin each
     job's fold order for bit-identity assertions. ``join_timeout`` bounds
     the wait on each job thread — a job still running after it gets a
-    ``TimeoutError`` result instead of wedging the caller."""
+    ``TimeoutError`` result instead of wedging the caller. ``trace_dir``
+    installs one causal-trace lane PER JOB (the job's threads are already
+    bound to its name, so every rank's spans land in the job's tracer),
+    arms cross-rank context stamping on each job's comm facades, and
+    exports ``trace_<job>.jsonl`` per job for tools/trace_merge.py —
+    N federations merge into ONE trace with one lane per job."""
     jobs = list(jobs)
     _validate(jobs)
     world = 1 + sum(j.worker_num for j in jobs)
@@ -127,13 +133,16 @@ def run_multi_job(
             if job.on_round is not None:
                 job.on_round(r, unpacked)
 
+        run_kwargs = dict(job.run_kwargs)
+        if trace_dir is not None:
+            run_kwargs.setdefault("trace_wire", True)
         try:
             with jobscope.bound(job.name):
                 result.final = run_distributed_fedavg(
                     job.trainer, job.train_data, job.worker_num,
                     job.round_num, job.batch_size, make_comm,
                     seed=job.seed, on_round_done=on_round,
-                    fleet_stats=fleet_stats, **job.run_kwargs,
+                    fleet_stats=fleet_stats, **run_kwargs,
                 )
         except BaseException as e:  # noqa: BLE001 — captured per-job by contract
             result.error = e
@@ -142,6 +151,16 @@ def run_multi_job(
                 registry.uninstall_job(job.name)
         result.fleet_stats = fleet_stats
 
+    _lane_traces = None
+    if trace_dir is not None:
+        from fedml_tpu.obs import trace
+
+        # one lane per job, keyed by the job name the threads are already
+        # bound to — per-rank lanes would collide across jobs in the
+        # process-global job-tracer namespace
+        _lane_traces = trace.lane_traces(trace_dir,
+                                         [job.name for job in jobs])
+        _lane_traces.__enter__()
     try:
         threads = [
             threading.Thread(target=run_job, args=(job,),
@@ -168,6 +187,8 @@ def run_multi_job(
         router.close()
         scheduler.close()
         pool.close()
+        if _lane_traces is not None:
+            _lane_traces.__exit__(None, None, None)
     if out_dir is not None:
         _write_outputs(out_dir, jobs, results)
     return results

@@ -159,6 +159,27 @@ def summarize(view: dict, rounds: int = 0) -> dict:
     }
 
 
+def attach_critical_paths(report: dict, trace_dir: str | Path) -> dict:
+    """Join per-round gating attribution into the fleet report: merge the
+    ``trace_<lane>.jsonl`` exports under ``trace_dir`` (tools/trace_merge.py)
+    and walk each round close's causal chain (tools/trace_report.py), so the
+    fleet view answers not just "who is slow" but "who held THIS round open"
+    (docs/OBSERVABILITY.md "Reading a round's critical path")."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge
+    import trace_report
+
+    merged = trace_merge.merge_dir(trace_dir)
+    report["critical_rounds"] = [
+        {"round": r["round"], "terminal": r["name"], "lane": r["lane"],
+         "close_ms": r["close_ms"], "timed_out": r["timed_out"],
+         "gating_rank": r["gating_rank"], "gating_lane": r["gating_lane"],
+         "gating_span": r["gating_span"], "gating_ms": r["gating_ms"]}
+        for r in trace_report.critical_paths(merged)
+    ]
+    return report
+
+
 def _fmt_bucket_rows(snap: dict) -> list[tuple[str, int]]:
     rows = []
     if snap.get("zeros"):
@@ -237,6 +258,21 @@ def format_text(report: dict) -> str:
                 f"{r['rank']:>4} {pred:>10g} {act:>10g} "
                 f"{_na(ratio):>9} {_na(r.get('pop_dropped_uploads'), '{:g}'):>8}"
             )
+    if report.get("critical_rounds"):
+        lines += [
+            "",
+            "round critical paths (which rank held each round open — "
+            "merged causal trace, tools/trace_report.py):",
+            f"{'round':>5} {'lane':<8} {'close_ms':>9} {'gating rank':>11} "
+            f"{'gating leg':<22} {'gating_ms':>9}",
+        ]
+        for r in report["critical_rounds"]:
+            leg = f"{_na(r['gating_lane'])}:{r['gating_span']}"
+            lines.append(
+                f"{_na(r['round']):>5} {_na(r['lane']):<8} "
+                f"{r['close_ms']:>9g} {_na(r['gating_rank']):>11} "
+                f"{leg:<22} {r['gating_ms']:>9g}"
+            )
     for name in FLEET_HISTOGRAMS:
         lines += _render_histogram(name, report["histograms"].get(name))
     if report["timelines"]:
@@ -258,9 +294,16 @@ def main(argv=None) -> int:
     p.add_argument("fleet", help="fleet.jsonl (per-round snapshots) or "
                                  "fleet.json totals from --fleet_stats")
     p.add_argument("--format", choices=["text", "json"], default="text")
+    p.add_argument("--trace", default=None, metavar="DIR",
+                   help="directory of trace_<lane>.jsonl exports from the "
+                        "same run (trace_lanes=/trace_dir= knobs): adds the "
+                        "per-round gating-rank attribution from the merged "
+                        "causal trace")
     args = p.parse_args(argv)
     view, rounds = load_fleet(args.fleet)
     report = summarize(view, rounds)
+    if args.trace is not None:
+        attach_critical_paths(report, args.trace)
     if args.format == "json":
         print(json.dumps(report))
     else:

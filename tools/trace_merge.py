@@ -1,0 +1,260 @@
+"""Merge per-rank fedml_tpu traces (obs/trace.py ``trace_<lane>.jsonl``
+exports) into ONE Chrome trace-event file: each lane becomes its own
+Perfetto process track, wire-propagated trace contexts
+(``MSG_ARG_KEY_TRACE_CTX``, stamped by comm/base.py when ``trace_wire`` is
+armed) become flow arrows from each send span to its receive span, and
+per-lane clocks are aligned by pairwise skew estimated from those same
+send<->recv pairs (docs/OBSERVABILITY.md "Cross-rank causal tracing").
+
+    python tools/trace_merge.py RUN_DIR                 # -> RUN_DIR/trace.merged.json
+    python tools/trace_merge.py RUN_DIR -o merged.json
+
+Clock model: every lane's timestamps are microseconds on its own
+``time.perf_counter`` axis, wall-anchored by the ``trace/meta`` record's
+``wall0``. The wall anchor is the PRIMARY alignment; send<->recv pairs
+only bound the residual skew: with ``d_AB = min(recv_ts - send_ts)`` over
+the A->B messages, any latency >= 0 means the true skew of B relative to A
+lies in ``[-d_BA, d_AB]``. The correction applied is the smallest-magnitude
+value in that interval (zero when the wall anchors already satisfy
+causality both ways — so an asymmetric wire, e.g. a delay-injected uplink,
+is never mistaken for clock skew), and only when the interval is empty
+(genuine drift: a receive observably lands before its send) does it fall
+back to the symmetric-latency midpoint ``(d_AB - d_BA) / 2``. One-direction
+pairs correct only if their gap is negative; unpaired lanes keep the wall
+anchor alone. Offsets propagate by BFS from the reference lane (first in
+sorted order), so chains of tiers align even when the outer lanes never
+exchanged a message directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+MERGED_TRACE_NAME = "trace.merged.json"
+META_EVENT_NAME = "trace/meta"
+FLOW_NAME = "wire"
+
+
+def load_lane(path: str | Path) -> dict:
+    """Load one per-lane JSONL export. Returns ``{"lane", "wall0",
+    "events", "thread_names", "truncated"}``. A torn final line (the
+    process died mid-write) is dropped and flagged, not fatal — the rest
+    of the file is intact by construction (one event per line)."""
+    path = Path(path)
+    events: list[dict] = []
+    thread_names: dict[int, str] = {}
+    lane = None
+    wall0 = None
+    truncated = False
+    lines = path.read_text().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                truncated = True
+                continue
+            raise ValueError(f"{path}:{i + 1}: undecodable trace line")
+        if rec.get("ph") == "M":
+            if rec.get("name") == META_EVENT_NAME:
+                lane = rec.get("args", {}).get("lane")
+                wall0 = rec.get("args", {}).get("wall0")
+            elif rec.get("name") == "thread_name":
+                thread_names[rec.get("tid", 0)] = rec.get(
+                    "args", {}).get("name", "")
+            continue
+        events.append(rec)
+    if lane is None:  # pre-meta export or hand-built file: name by stem
+        lane = path.stem.removeprefix("trace_")
+    return {"lane": lane, "wall0": wall0, "events": events,
+            "thread_names": thread_names, "truncated": truncated}
+
+
+def lane_files(trace_dir: str | Path) -> list[Path]:
+    """The per-lane exports in a run directory (``trace_<lane>.jsonl``,
+    what ``trace.lane_traces`` and the ``trace_lanes=`` runner knobs
+    write)."""
+    return sorted(Path(trace_dir).glob("trace_*.jsonl"))
+
+
+def _wire_links(lanes: dict[str, dict]) -> list[dict]:
+    """Match each receive-side span carrying a wire context to the send
+    span it names: ``(ctx_lane, ctx_span)`` -> that lane's span with the
+    same ``span_id``. Unmatched contexts (sender lane not captured, or the
+    send span evicted by the ring) are skipped."""
+    by_span: dict[tuple[str, int], dict] = {}
+    for lane, data in lanes.items():
+        for e in data["events"]:
+            sid = e.get("args", {}).get("span_id")
+            if sid is not None:
+                by_span[(lane, sid)] = e
+    links = []
+    for lane, data in lanes.items():
+        for e in data["events"]:
+            args = e.get("args", {})
+            src_lane, src_span = args.get("ctx_lane"), args.get("ctx_span")
+            if src_lane is None or src_span is None:
+                continue
+            src = by_span.get((src_lane, src_span))
+            if src is None:
+                continue
+            links.append({"src_lane": src_lane, "src": src,
+                          "dst_lane": lane, "dst": e})
+    return links
+
+
+def _estimate_offsets(lanes: dict[str, dict],
+                      links: list[dict]) -> dict[str, float]:
+    """Per-lane correction (microseconds, subtracted from the lane's
+    wall-anchored timeline) aligning every lane to the reference lane's
+    clock — the module-doc skew model."""
+    anchors = {lane: (data["wall0"] or 0.0) * 1e6
+               for lane, data in lanes.items()}
+    d: dict[tuple[str, str], float] = {}
+    for lk in links:
+        send = anchors[lk["src_lane"]] + lk["src"]["ts"]
+        recv = anchors[lk["dst_lane"]] + lk["dst"]["ts"]
+        key = (lk["src_lane"], lk["dst_lane"])
+        delta = recv - send
+        if key not in d or delta < d[key]:
+            d[key] = delta
+    # residual skew per undirected pair (how far B's wall-anchored clock
+    # runs ahead of A's): the smallest correction inside the causal bound
+    # [-d_BA, d_AB] — see the module doc's clock model
+    rel: dict[tuple[str, str], float] = {}
+    for (a, b), d_ab in d.items():
+        if (a, b) in rel or (b, a) in rel:
+            continue
+        d_ba = d.get((b, a))
+        if d_ba is None:
+            rel[(a, b)] = min(d_ab, 0.0)
+        elif -d_ba > d_ab:  # empty feasible interval: genuine drift
+            rel[(a, b)] = (d_ab - d_ba) / 2.0
+        else:
+            rel[(a, b)] = min(max(0.0, -d_ba), d_ab)
+    offsets = {lane: 0.0 for lane in lanes}
+    if not lanes:
+        return offsets
+    ref = sorted(lanes)[0]
+    seen = {ref}
+    frontier = [ref]
+    while frontier:
+        nxt = []
+        for a in frontier:
+            for (x, y), skew in rel.items():
+                other, ahead = ((y, skew) if x == a
+                                else (x, -skew) if y == a else (None, 0.0))
+                if other is not None and other not in seen:
+                    offsets[other] = offsets[a] + ahead
+                    seen.add(other)
+                    nxt.append(other)
+        frontier = nxt
+    return offsets
+
+
+def merge(paths: list[str | Path]) -> dict:
+    """Merge per-lane JSONL exports into one Chrome trace payload.
+
+    Returns ``{"traceEvents", "lanes" (lane -> pid), "offsets_us",
+    "links" (matched wire pairs), "truncated" (lanes with a torn final
+    line)}``; ``traceEvents`` is Perfetto-loadable as-is: per-lane
+    process tracks, per-thread named tracks, ``s``/``f`` flow arrows for
+    every matched send<->recv pair, and timestamps normalized onto the
+    reference lane's clock starting at 0."""
+    lanes: dict[str, dict] = {}
+    for p in paths:
+        data = load_lane(p)
+        if data["lane"] in lanes:
+            raise ValueError(f"duplicate lane {data['lane']!r} in {p}")
+        lanes[data["lane"]] = data
+    links = _wire_links(lanes)
+    offsets = _estimate_offsets(lanes, links)
+    anchors = {lane: (data["wall0"] or 0.0) * 1e6
+               for lane, data in lanes.items()}
+
+    def aligned(lane: str, ts: float) -> float:
+        return anchors[lane] + ts - offsets[lane]
+
+    t0 = min((aligned(lane, e["ts"]) for lane, data in lanes.items()
+              for e in data["events"]), default=0.0)
+    pids = {lane: i + 1 for i, lane in enumerate(sorted(lanes))}
+    out: list[dict] = []
+    for lane, data in lanes.items():
+        pid = pids[lane]
+        out.append({"name": "process_name", "ph": "M", "pid": pid,
+                    "tid": 0, "args": {"name": lane}})
+        for tid, tname in sorted(data["thread_names"].items()):
+            out.append({"name": "thread_name", "ph": "M", "pid": pid,
+                        "tid": tid, "args": {"name": tname}})
+        for e in data["events"]:
+            out.append({**e, "pid": pid, "ts": aligned(lane, e["ts"]) - t0})
+    for i, lk in enumerate(links):
+        src, dst = lk["src"], lk["dst"]
+        common = {"name": FLOW_NAME, "cat": FLOW_NAME, "id": i + 1}
+        out.append({**common, "ph": "s", "pid": pids[lk["src_lane"]],
+                    "tid": src.get("tid", 0),
+                    "ts": aligned(lk["src_lane"], src["ts"]) - t0})
+        out.append({**common, "ph": "f", "bp": "e",
+                    "pid": pids[lk["dst_lane"]], "tid": dst.get("tid", 0),
+                    "ts": aligned(lk["dst_lane"], dst["ts"]) - t0})
+    return {
+        "traceEvents": out,
+        "lanes": pids,
+        "offsets_us": {lane: round(off, 3) for lane, off in offsets.items()},
+        "links": links,
+        "truncated": sorted(lane for lane, data in lanes.items()
+                            if data["truncated"]),
+    }
+
+
+def merge_dir(trace_dir: str | Path) -> dict:
+    """:func:`merge` over every ``trace_*.jsonl`` in ``trace_dir``."""
+    paths = lane_files(trace_dir)
+    if not paths:
+        raise FileNotFoundError(
+            f"no trace_*.jsonl lane exports under {trace_dir} — run with "
+            "trace_lanes=/trace_dir= (see docs/OBSERVABILITY.md)")
+    return merge(paths)
+
+
+def write_chrome(merged: dict, path: str | Path) -> Path:
+    """Write the Perfetto-loadable file (flows and metadata included;
+    the library-only keys stay out of the JSON)."""
+    path = Path(path)
+    payload = {
+        "traceEvents": merged["traceEvents"],
+        "displayTimeUnit": "ms",
+        "traceMeta": {"lanes": merged["lanes"],
+                      "offsets_us": merged["offsets_us"],
+                      "links": len(merged["links"]),
+                      "truncated": merged["truncated"]},
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser("fedml_tpu multi-rank trace merger")
+    p.add_argument("trace_dir",
+                   help="directory of per-lane trace_<lane>.jsonl exports")
+    p.add_argument("-o", "--out", default=None,
+                   help=f"output path (default: <trace_dir>/{MERGED_TRACE_NAME})")
+    args = p.parse_args(argv)
+    merged = merge_dir(args.trace_dir)
+    out = Path(args.out) if args.out else Path(args.trace_dir) / MERGED_TRACE_NAME
+    write_chrome(merged, out)
+    n_lanes = len(merged["lanes"])
+    print(f"merged {n_lanes} lanes, {len(merged['links'])} wire links -> {out}"
+          + (f" (torn final line in: {', '.join(merged['truncated'])})"
+             if merged["truncated"] else ""))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

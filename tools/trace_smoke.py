@@ -6,6 +6,14 @@ tools/trace_report.py, carries spans from all five instrumented layers
 events, and the traced sim's records are identical to an untraced run
 (tracing is read-only).
 
+The multi-rank arm then runs a small 2-tier loopback tree with per-node
+lanes (``trace_lanes=``), merges the per-lane exports into ONE Chrome
+trace with tools/trace_merge.py, schema-checks the merged stream (open
+``B`` spans and ``s``/``f`` wire flows included), asserts every round
+close is causally linked across lanes back to a ``client/train`` span by
+the wire-propagated contexts, and re-asserts bit-identity against an
+untraced run of the same tree.
+
     JAX_PLATFORMS=cpu python tools/trace_smoke.py
 """
 
@@ -80,10 +88,82 @@ def _run_compressed_loopback():
     return comm_stats
 
 
+def _run_tree(trace_dir: str | None):
+    """One small 2-tier loopback tree run (root -> 2 edges -> 4 leaves);
+    ``trace_dir`` installs per-node lanes + wire contexts, None runs the
+    identical computation untraced."""
+    import optax
+
+    from fedml_tpu.async_agg.tree import run_tree_fedavg_loopback
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(n_clients=4, samples_per_client=16,
+                              num_classes=4, seed=5)
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+    return run_tree_fedavg_loopback(trainer, train, (2, 2), 2, 8,
+                                    trace_lanes=trace_dir)
+
+
+def _check_multi_rank(tmp: Path, trace_report, trace_merge) -> dict:
+    """The multi-rank arm: traced tree vs untraced tree bit-identical,
+    lanes merge into one Perfetto stream, round closes causally linked
+    back to client/train across lanes."""
+    import jax
+    import numpy as np
+
+    tree_dir = tmp / "tree_lanes"
+    tree_dir.mkdir()
+    ref = _run_tree(None)
+    traced = _run_tree(str(tree_dir))
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(traced)):
+        assert np.asarray(a).tobytes() == np.asarray(b).tobytes(), (
+            "traced tree run differs from untraced — tracing must be "
+            "read-only"
+        )
+
+    merged = trace_merge.merge_dir(tree_dir)
+    out = trace_merge.write_chrome(merged, tree_dir / trace_merge.MERGED_TRACE_NAME)
+    assert out.exists()
+    assert len(merged["lanes"]) == 7, merged["lanes"]  # root+2 edges+4 leaves
+    assert merged["links"], "no wire context matched a send span"
+    assert not merged["truncated"]
+
+    # merged-stream schema: open spans stay as B begins, wire flows come
+    # in s/f pairs sharing an id, every X span still carries dur
+    flow_ids: dict[str, list] = {"s": [], "f": []}
+    for e in merged["traceEvents"]:
+        ph = e.get("ph")
+        assert ph in ("X", "C", "i", "B", "M", "s", "f"), e
+        if ph == "X":
+            assert "dur" in e and e["dur"] >= 0, e
+        if ph in ("s", "f"):
+            flow_ids[ph].append(e["id"])
+    assert flow_ids["s"] and sorted(flow_ids["s"]) == sorted(flow_ids["f"])
+
+    rows = trace_report.critical_paths(merged)
+    closes = [r for r in rows if r["name"] == "round/close"]
+    assert closes, "no round/close terminals in the merged trace"
+    for row in closes:
+        names = [n["name"] for n in row["chain"]]
+        assert row["crossed_lanes"], row
+        assert any(n.startswith("client/train") for n in names), (
+            f"round {row['round']} close not causally linked to a "
+            f"client/train span; chain = {names}"
+        )
+    return {"lanes": len(merged["lanes"]), "links": len(merged["links"]),
+            "closes": len(closes)}
+
+
 def main(argv=None) -> int:
     from fedml_tpu.obs import trace
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import trace_merge
     import trace_report
 
     with tempfile.TemporaryDirectory() as td:
@@ -134,12 +214,16 @@ def main(argv=None) -> int:
         assert report["stall_fraction"] is not None
         assert tracer.events(), "tracer recorded nothing"
 
+        multi = _check_multi_rank(tmp, trace_report, trace_merge)
+
         print(
             f"trace smoke OK: {report['events']} events, "
             f"{len(span_names)} span kinds across all 5 layers "
             f"({', '.join(sorted(p.rstrip('/') for p in LAYERS))}); "
             f"stall fraction {report['stall_fraction']}, "
-            f"traced == untraced records"
+            f"traced == untraced records; multi-rank: {multi['lanes']} lanes "
+            f"merged, {multi['links']} wire links, {multi['closes']} round "
+            f"closes causally linked to client/train"
         )
     return 0
 
