@@ -1152,6 +1152,93 @@ def bench_async_ab(n_rounds: int = 3):
     return out
 
 
+def bench_fold_ab(n_rounds: int = 2):
+    """Sharded fold plane A/B (docs/PERFORMANCE.md "The server fold
+    plane"): 16-client loopback fan-in with an ~8 MB dense payload and a
+    no-op local train, so the round is the SERVER's fold throughput, not
+    client compute. Reports uploads/sec and the upload-handler p99 with
+    the plane off vs on (4 chunk workers). The speedup assertions
+    (>= 2.5x uploads/sec, >= 5x handler-p99 drop) only arm on hosts with
+    >= 4 cores — thread parallelism cannot pay for itself without them,
+    so a single-core container just reports the numbers."""
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        FedAvgClientManager,
+        MyMessage,
+        run_distributed_fedavg_loopback,
+    )
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+    from fedml_tpu.obs import trace
+
+    workers = 16
+    dim, classes = 32768, 64  # (dim+1) x classes f32 params ~= 8.0 MB
+    train, _ = gaussian_blobs(n_clients=workers, samples_per_client=2,
+                              num_classes=classes, dim=dim, seed=0)
+    trainer = ClientTrainer(module=LogisticRegression(num_classes=classes),
+                            optimizer=optax.sgd(0.1), epochs=1)
+
+    def no_train(variables, batches, key):
+        return variables, None
+
+    def client_cls(rank):
+        def make(comm, r, size, tr, data, bs, tmpl):
+            return FedAvgClientManager(comm, r, size, tr, data, bs, tmpl,
+                                       local_train_fn=no_train)
+
+        return make
+
+    def run(**kw):
+        tracer = trace.install(trace.Tracer())
+        try:
+            t0 = time.perf_counter()
+            run_distributed_fedavg_loopback(
+                trainer, train, worker_num=workers, round_num=n_rounds,
+                batch_size=2, client_cls_for_rank=client_cls, **kw,
+            )
+            dt = time.perf_counter() - t0
+        finally:
+            trace.uninstall()
+        # upload-handler wall time only: the sync fan-out and init legs
+        # share the span name but not the bottleneck under test
+        handler_ms = sorted(
+            e["dur"] / 1e3 for e in tracer.events()
+            if e["name"] == "comm/handler" and e.get("ph") == "X"
+            and e.get("args", {}).get("msg_type")
+            == MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        )
+        p99 = (handler_ms[min(len(handler_ms) - 1,
+                              int(0.99 * len(handler_ms)))]
+               if handler_ms else 0.0)
+        return n_rounds * workers / dt, p99
+
+    run()  # warm: thread spinup, allocator, loopback queues
+    serial_ups, serial_p99 = run()
+    run(fold_workers=4)
+    plane_ups, plane_p99 = run(fold_workers=4)
+    out = {
+        "fold_payload_bytes": (dim + 1) * classes * 4,
+        "fold_serial_uploads_per_sec": round(serial_ups, 1),
+        "fold_plane_uploads_per_sec": round(plane_ups, 1),
+        "fold_uploads_speedup": round(plane_ups / max(serial_ups, 1e-9), 2),
+        "fold_serial_handler_p99_ms": round(serial_p99, 2),
+        "fold_plane_handler_p99_ms": round(plane_p99, 2),
+        "fold_handler_p99_drop": round(serial_p99 / max(plane_p99, 1e-9), 1),
+    }
+    cores = os.cpu_count() or 1
+    if cores >= 4:
+        assert out["fold_uploads_speedup"] >= 2.5, out
+        assert out["fold_handler_p99_drop"] >= 5.0, out
+    else:
+        out["fold_gate"] = (
+            f"cpu_count={cores} < 4: speedup assertions skipped (chunk "
+            "workers need cores to beat the serial fold)"
+        )
+    return out
+
+
 def bench_shard_ab(peak_tflops, fallback_reason):
     """Sharded-client-model A/B (docs/PERFORMANCE.md "Sharded client
     models"). On a real multi-chip TPU: the benched LM round with the
@@ -1782,6 +1869,12 @@ def _main(stage: list):
         pipeline_extra.update(bench_async_ab())
     except Exception as e:  # the probe must never sink the bench artifact
         pipeline_extra["async_error"] = f"{type(e).__name__}: {e}"
+
+    stage[0] = "bench_fold_probe"
+    try:
+        pipeline_extra.update(bench_fold_ab())
+    except Exception as e:  # the probe must never sink the bench artifact
+        pipeline_extra["fold_error"] = f"{type(e).__name__}: {e}"
 
     stage[0] = "bench_population_probe"
     try:

@@ -31,7 +31,9 @@ from fedml_tpu.comm.message import Message, pack_pytree, unpack_pytree
 from fedml_tpu.comm.send_pool import BroadcastSendError
 from fedml_tpu.core import rng as rnglib
 from fedml_tpu.core.trainer import ClientTrainer, make_local_train
+from fedml_tpu.algorithms.fold_plane import DenseFoldTask, FoldPlane, FoldTask
 from fedml_tpu.obs import jobscope, registry
+from fedml_tpu.obs import metrics as metricslib
 from fedml_tpu.obs import trace
 from fedml_tpu.sim.cohort import FederatedArrays, stack_cohort
 
@@ -87,6 +89,19 @@ class FedAvgDistAggregator:
         self._wsum = 0.0  # guarded-by: _lock
         # workers dropped via exclude_worker
         self._excluded: list[int] = []  # guarded-by: _lock
+        # sharded fold plane (algorithms/fold_plane.py): None = serial fold
+        # on the receive thread, exactly the pre-plane behavior
+        self._plane: FoldPlane | None = None
+        self._pending_finalize: list[FoldTask] = []  # guarded-by: _lock
+        # bumped on every tally mutation (fold submit/apply, finish,
+        # restore) — the torn-copy detector for the outside-the-lock
+        # snapshot copy (snapshot_state retries while it moves)
+        self._fold_epoch = 0  # guarded-by: _lock
+        # the plane creates the accumulator at submit time (workers need a
+        # target before the first fold lands); if NO submitted task ends up
+        # contributing vector mass (a robust all-rejected window) the drain
+        # nulls it again so `_acc is None` keeps meaning "empty tally"
+        self._acc_provisional = False  # guarded-by: _lock
 
     def exclude_worker(self, index: int) -> None:
         """Stop expecting this worker (marked OFFLINE): later rounds
@@ -148,25 +163,47 @@ class FedAvgDistAggregator:
         values plus JSON-safe scalars (obs.checkpoint.RoundCheckpointer.
         save_server splits them). Saved at round close, when the streaming
         accumulator is empty; mid-round acc/wsum are included anyway so a
-        future mid-round snapshotter inherits them for free."""
-        with self._lock:
-            out: dict = {
-                "wsum": float(self._wsum),
-                "live": sorted(self.flag_client_model_uploaded_dict),
-                "uploaded": sorted(
-                    i for i, f in self.flag_client_model_uploaded_dict.items()
-                    if f
-                ),
-                "excluded": sorted(self._excluded),
-                "sample_num": {str(i): float(v)
-                               for i, v in self.sample_num_dict.items()},
-            }
-            if self._acc is not None:
-                out["acc"] = np.array(self._acc)
-            return out
+        future mid-round snapshotter inherits them for free.
+
+        The full-model accumulator copy happens OUTSIDE the lock (the PR 8
+        checkpoint-write-outside-lock discipline — a checkpoint must not
+        stall arriving folds): grab the reference and the fold epoch under
+        the lock, copy unlocked, and retry if the epoch moved (a fold
+        landed mid-copy — serial or from a plane worker — so the copy may
+        be torn)."""
+        while True:
+            with self._lock:
+                self._drain_locked()
+                epoch = self._fold_epoch
+                acc_ref = self._acc
+                out: dict = {
+                    "wsum": float(self._wsum),
+                    "live": sorted(self.flag_client_model_uploaded_dict),
+                    "uploaded": sorted(
+                        i for i, f in
+                        self.flag_client_model_uploaded_dict.items() if f
+                    ),
+                    "excluded": sorted(self._excluded),
+                    "sample_num": {str(i): float(v)
+                                   for i, v in self.sample_num_dict.items()},
+                }
+            acc_copy = None if acc_ref is None else np.array(acc_ref)
+            with self._lock:
+                if self._fold_epoch != epoch:
+                    continue  # a fold landed mid-copy; re-snapshot
+                if acc_copy is not None:
+                    out["acc"] = acc_copy
+                return out
 
     def restore_state(self, state: dict) -> None:
         with self._lock:
+            # retire any in-flight folds against the PRE-restore tally
+            # first: their target array and scalar bookkeeping are both
+            # replaced wholesale below, exactly as a serial restore
+            # overwrites folds that already landed
+            self._drain_locked()
+            self._fold_epoch += 1
+            self._acc_provisional = False
             self._wsum = float(state.get("wsum", 0.0))
             acc = state.get("acc")
             self._acc = None if acc is None else np.asarray(acc, np.float64)
@@ -190,11 +227,73 @@ class FedAvgDistAggregator:
         with self._lock:
             return index in self.flag_client_model_uploaded_dict
 
+    # -- sharded fold plane seam (algorithms/fold_plane.py) ------------------
+
+    def attach_fold_plane(self, plane: FoldPlane) -> None:
+        """Arm the chunk-parallel fold plane: subsequent arrivals that have
+        a task form (:meth:`_fold_task`) enqueue to the plane's workers
+        instead of folding on the receive thread. Aggregator families whose
+        fold is not chunkable (a non-mean robust rule) override this to a
+        no-op and keep the serial path."""
+        self._plane = plane
+
+    def close_fold_plane(self) -> None:
+        """Shut the plane's workers down (idempotent; serial-mode no-op)."""
+        if self._plane is not None:
+            self._plane.close()
+
+    def _fold_task(self, payload, weight: float) -> FoldTask | None:
+        """The family-specific task form of one arrival, or None when this
+        payload must fold serially (caller holds the lock)."""
+        return DenseFoldTask(payload, weight)
+
+    def _fold_arrival(self, payload, weight: float) -> None:  # lock-held: _lock
+        """Arrival-order fold dispatch: serial ``_fold`` when the plane is
+        off (or the payload has no task form — the queues drain first so a
+        mixed schedule stays in arrival order), task submit when it is on.
+        Caller holds ``_lock``, so plane sequence order IS arrival order."""
+        self._fold_epoch += 1
+        task = self._fold_task(payload, weight) if self._plane is not None else None
+        if task is None:
+            self._drain_locked()
+            self._fold(payload, weight)
+            return
+        if self._acc is None:
+            self._acc = np.zeros(task.acc_elems, np.float64)
+            self._acc_provisional = True
+            task.first = True
+        self._pending_finalize.append(task)
+        self._plane.submit(task, self._acc)
+
+    def _drain_locked(self) -> None:  # lock-held: _lock
+        """Quiesce the plane before any read of the tally: help-fold
+        whatever is still queued (wait-free — see FoldPlane.drain), then
+        run each task's scalar finalize in arrival order so order-sensitive
+        float sums (weight totals, defense stats) reproduce the serial
+        bits. Every tally reader (aggregate / snapshot / restore / emit /
+        export) calls this first."""
+        if self._plane is None or not self._pending_finalize:
+            return
+        t0 = time.perf_counter()
+        with trace.span("fold/drain", pending=len(self._pending_finalize)):
+            self._plane.drain()
+            pending, self._pending_finalize = self._pending_finalize, []
+            folded = False
+            for task in pending:
+                folded = bool(task.finalize(self)) or folded
+            if self._acc_provisional:
+                self._acc_provisional = False
+                if not folded:
+                    self._acc = None
+        registry.observe(metricslib.FOLD_STALL_MS,
+                         (time.perf_counter() - t0) * 1000.0)
+
     def _fold(self, payload, sample_num: float) -> None:  # lock-held: _lock
         """Fold one upload into the running tally (caller holds the lock).
         Payloads are pack_pytree byte vectors; model leaves are float32
         (validated against the descriptor at server init), so the weighted
         accumulation runs on an f32 view."""
+        self._fold_epoch += 1
         x = np.ascontiguousarray(payload).view(np.float32)
         if self._acc is None:
             self._acc = np.zeros(x.size, np.float64)
@@ -204,6 +303,7 @@ class FedAvgDistAggregator:
     def _finish(self) -> np.ndarray:  # lock-held: _lock
         """Close the tally (caller holds the lock): divide by the weight sum
         and return wire bytes."""
+        self._fold_epoch += 1
         out = (self._acc / self._wsum).astype(np.float32).view(np.uint8)
         self._acc = None
         self._wsum = 0.0
@@ -219,7 +319,7 @@ class FedAvgDistAggregator:
                 # tally cannot replace a folded contribution; the protocol's
                 # round-idx guard keeps this unreachable in practice)
                 return all(flags.values())
-            self._fold(flat_params, sample_num)
+            self._fold_arrival(flat_params, sample_num)
             self.sample_num_dict[index] = sample_num
             flags[index] = True
             return all(flags.values())
@@ -233,6 +333,7 @@ class FedAvgDistAggregator:
         # the synchronous case; the survivors when the elastic round timeout
         # dropped stragglers) with weights renormalized over that subset.
         with self._lock:
+            self._drain_locked()
             flags = self.flag_client_model_uploaded_dict
             if not any(flags.values()):
                 raise self._empty_round_error()
@@ -254,6 +355,11 @@ class BufferedFedAvgDistAggregator(FedAvgDistAggregator):
         super().__init__(worker_num)
         # insertion == arrival
         self.model_dict: dict[int, np.ndarray] = {}  # guarded-by: _lock
+
+    def attach_fold_plane(self, plane) -> None:
+        """No-op: the buffered A/B arm replays at round close by contract
+        (its whole point is the legacy retain-then-sum shape), so there is
+        nothing to move off the receive thread."""
 
     def add_local_trained_result(self, index: int, flat_params: np.ndarray, sample_num: float) -> bool:
         with self._lock:
@@ -299,8 +405,16 @@ class FedAvgServerManager(ServerManager):
                  fleet=None,
                  downlink_codec=None,
                  downlink_keyframe_every: int = 8,
-                 downlink_retention: int = 4):
+                 downlink_retention: int = 4,
+                 fold_workers: int = 0,
+                 fold_chunk: int | None = None):
         super().__init__(comm, rank=0, size=worker_num + 1)
+        # sharded fold plane (algorithms/fold_plane.py, docs/PERFORMANCE.md
+        # "The server fold plane"): fold_workers > 0 moves upload folding
+        # off the receive thread onto that many chunk workers, bit-identical
+        # to the serial fold; 0 (default) keeps the pre-plane serial path
+        self.fold_workers = int(fold_workers)
+        self.fold_chunk = fold_chunk
         self.worker_num = worker_num
         self.round_num = round_num
         self.round_idx = 0
@@ -399,8 +513,9 @@ class FedAvgServerManager(ServerManager):
         # _make_aggregator and hoist whatever config it reads (codec,
         # robust_config) ABOVE their super().__init__ call — the diamond
         # composes by overriding the factory, never by reassigning the
-        # already-built tally
-        self.aggregator = self._make_aggregator()
+        # already-built tally; the fold plane attaches at the same seam so
+        # every variant of the diamond gets it without per-class wiring
+        self.aggregator = self._attach_fold_plane(self._make_aggregator())
 
     def _make_aggregator(self):
         """Build this server's round tally. Called exactly once, at the end
@@ -411,6 +526,24 @@ class FedAvgServerManager(ServerManager):
             BufferedFedAvgDistAggregator if self.buffered_aggregation
             else FedAvgDistAggregator
         )(self.worker_num)
+
+    def _attach_fold_plane(self, agg):
+        """Arm the sharded fold plane on the freshly-built tally when
+        ``fold_workers > 0`` (pass-through otherwise). Runs at the ONE
+        construction call site, so every ``_make_aggregator`` override in
+        the diamond inherits it; families that cannot chunk their fold
+        (buffered replay, non-mean robust rules) no-op their
+        ``attach_fold_plane`` and stay serial."""
+        if self.fold_workers > 0:
+            kwargs = {}
+            if self.fold_chunk is not None:
+                kwargs["chunk_elems"] = int(self.fold_chunk)
+            agg.attach_fold_plane(FoldPlane(self.fold_workers, **kwargs))
+        return agg
+
+    def finish(self) -> None:
+        self.aggregator.close_fold_plane()
+        super().finish()
 
     def _make_accountant(self):
         """Build the bytes-on-wire ledger (or None when nothing encodes).
@@ -1175,7 +1308,17 @@ class CompressedDistAggregator(FedAvgDistAggregator):
         accumulate_encoded(self._acc, payload, float(sample_num), self.codec)
         self._wsum += float(sample_num)
 
+    def _fold_task(self, payload, weight: float):
+        from fedml_tpu.algorithms.fold_plane import EncodedFoldTask
+
+        # sized from the round global like the serial first fold — only the
+        # SIZE is read here; decode runs in the task's prepare, off the
+        # receive thread
+        return EncodedFoldTask(payload, weight, self.codec,
+                               np.asarray(self.get_global()).nbytes // 4)
+
     def _finish(self) -> np.ndarray:
+        self._fold_epoch += 1
         acc = self._acc / self._wsum
         if self.codec.delta_domain:
             base = np.ascontiguousarray(self.get_global()).view(np.float32)
@@ -1398,6 +1541,8 @@ def run_distributed_fedavg(
     fleet_stats: dict | None = None,
     trace_lanes: str | None = None,
     trace_wire: bool = False,
+    fold_workers: int = 0,
+    fold_chunk: int | None = None,
 ):
     """End-to-end distributed FedAvg over any comm fabric: ``make_comm(rank)``
     builds rank 0's server transport and ranks 1..W's client transports
@@ -1571,6 +1716,13 @@ def run_distributed_fedavg(
         heartbeat_timeout = 3.0 * heartbeat_interval
     ckptr = None
     ft_kwargs: dict = {}
+    if fold_workers:
+        # sharded fold plane (docs/PERFORMANCE.md "The server fold plane"):
+        # bit-identical to the serial fold, so it composes with every server
+        # arm below — the knob just rides the server kwargs
+        ft_kwargs["fold_workers"] = int(fold_workers)
+        if fold_chunk is not None:
+            ft_kwargs["fold_chunk"] = int(fold_chunk)
     if heartbeat_timeout is not None:
         ft_kwargs["heartbeat_timeout"] = heartbeat_timeout
     if readmission:

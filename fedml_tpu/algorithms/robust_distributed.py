@@ -49,6 +49,7 @@ from fedml_tpu.algorithms.fedavg_distributed import (
     FedAvgDistAggregator,
     FedAvgServerManager,
 )
+from fedml_tpu.algorithms.fold_plane import FoldTask
 from fedml_tpu.algorithms.robust import (
     add_weak_dp_noise,
     clip_scale,
@@ -102,6 +103,101 @@ def _reservoir_rng(config: RobustDistConfig, round_idx: int) -> np.random.Random
     )
 
 
+class _RobustFoldTask(FoldTask):
+    """The mean-rule defended fold through the sharded plane: the whole
+    decision phase — delta against the submit-time global, full-vector
+    finiteness, the (BN-masked) clip norm and scale — runs once in prepare,
+    off the receive thread, with the exact serial expressions of
+    ``_defended_fold``; the chunk folds then apply the (possibly clipped)
+    vector with the base dense arithmetic. The defense's order-sensitive
+    scalars (``norm_sum`` is a float sum) are recorded on the task and
+    applied at drain in arrival order, so stats match the serial bits."""
+
+    __slots__ = ("payload", "weight", "base", "config", "norm_mask",
+                 "norm", "rejected", "clipped")
+
+    def __init__(self, payload, weight: float, base: np.ndarray,
+                 config: RobustDistConfig, norm_mask, acc_elems: int):
+        super().__init__(acc_elems)
+        self.payload = payload
+        self.weight = float(weight)
+        self.base = base  # f32 view of the global, captured at submit
+        self.config = config
+        self.norm_mask = norm_mask
+        self.norm = 0.0
+        self.rejected = False
+        self.clipped = False
+
+    def _dense_f32(self) -> np.ndarray | None:
+        return np.ascontiguousarray(self.payload).view(np.float32)
+
+    def _prepare(self):
+        x = self._dense_f32()
+        if x is None:  # undecodable encoded upload: rejected in finalize
+            self.rejected = True
+            return None
+        cfg = self.config
+        with trace.span("robust/fold", rule=cfg.rule):
+            base = self.base
+            delta = x - base
+            with trace.span("robust/clip"):
+                full_norm = float(np.linalg.norm(delta))
+                if not np.isfinite(full_norm):
+                    self.rejected = True
+                    return None
+                self.norm = (full_norm if self.norm_mask is None
+                             else flat_delta_norm(delta, self.norm_mask))
+                if cfg.norm_bound > 0:
+                    scale = float(clip_scale(jnp.float32(self.norm),
+                                             cfg.norm_bound))
+                    if scale < 1.0:
+                        self.clipped = True
+                        x = base + delta * np.float32(scale)
+            return x
+
+    def fold_slice(self, acc, lo, hi, prep):
+        acc[lo:hi] += np.multiply(prep[lo:hi], self.weight, dtype=np.float64)
+
+    def finalize(self, agg) -> bool:  # lock-held: _lock
+        agg._stats["n"] += 1
+        if self.rejected:
+            agg._stats["rejected"] += 1
+            return False
+        agg._stats["norm_sum"] += self.norm
+        if self.clipped:
+            agg._stats["clipped"] += 1
+        agg._wsum += self.weight
+        return True
+
+
+class _RobustEncodedFoldTask(_RobustFoldTask):
+    """Encoded-uplink variant: the decode (and the delta-domain lift onto
+    the submit-time global) joins the prepare phase; an undecodable payload
+    is just another hostile upload — rejected, never a crashed round."""
+
+    __slots__ = ("codec",)
+
+    def __init__(self, enc, weight: float, base: np.ndarray,
+                 config: RobustDistConfig, norm_mask, codec):
+        super().__init__(enc, weight, base, config, norm_mask,
+                         base.nbytes // 4)
+        self.codec = codec
+
+    def _dense_f32(self) -> np.ndarray | None:
+        from fedml_tpu.compress.aggregate import _flat_leaves
+
+        try:
+            with trace.span("compress/decode", scheme=self.payload.scheme):
+                leaves = _flat_leaves(self.codec.decode(self.payload))
+                dense = np.concatenate([l.astype(np.float32) for l in leaves])
+        except Exception as e:
+            logging.warning("robust fold: undecodable encoded upload "
+                            "rejected (%s: %s)", type(e).__name__, e)
+            return None
+        x = self.base + dense if self.codec.delta_domain else dense
+        return np.asarray(x, np.float32)
+
+
 class RobustDistAggregator(FedAvgDistAggregator):
     """Streaming tally with the defense folded into the arrival path.
 
@@ -124,6 +220,22 @@ class RobustDistAggregator(FedAvgDistAggregator):
         self._last_record: dict | None = None  # guarded-by: _lock
 
     # -- defended arrival fold ----------------------------------------------
+
+    def attach_fold_plane(self, plane) -> None:
+        """The plane composes with the ``mean`` rule only (two-phase: the
+        prepare-side norm/clip decision, then the weighted chunk folds).
+        Reservoir rules mutate seeded cross-client sampler state at every
+        arrival — inherently serial — so they keep the pre-plane path."""
+        if self.config.rule == "mean":
+            super().attach_fold_plane(plane)
+
+    def _fold_task(self, payload, weight: float):
+        # the clip reference is captured here, under the tally lock — the
+        # same global the serial fold would have read at this arrival
+        base = np.ascontiguousarray(self.get_global()).view(np.float32)
+        return _RobustFoldTask(payload, weight, base, self.config,
+                               self._norm_mask,
+                               np.asarray(payload).nbytes // 4)
 
     def _fold(self, payload, sample_num: float) -> None:
         x = np.ascontiguousarray(payload).view(np.float32)
@@ -179,6 +291,7 @@ class RobustDistAggregator(FedAvgDistAggregator):
 
     def _finish(self) -> np.ndarray:
         cfg = self.config
+        self._fold_epoch += 1
         with trace.span("robust/close", rule=cfg.rule):
             all_rejected = (self._acc is None if cfg.rule == "mean"
                             else not self._reservoir)
@@ -344,6 +457,11 @@ class RobustCompressedDistAggregator(RobustDistAggregator):
                  model_desc: str | None = None):
         super().__init__(worker_num, config, model_desc)
         self.codec = codec
+
+    def _fold_task(self, payload, weight: float):
+        base = np.ascontiguousarray(self.get_global()).view(np.float32)
+        return _RobustEncodedFoldTask(payload, weight, base, self.config,
+                                      self._norm_mask, self.codec)
 
     def _fold(self, payload, sample_num: float) -> None:
         from fedml_tpu.compress.aggregate import _flat_leaves

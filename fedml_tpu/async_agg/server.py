@@ -82,7 +82,10 @@ class _AsyncTallyMixin:
             last = self.last_folded.get(index)
             if last is not None and upload_version <= last:
                 return False
-            self._fold(payload, weight)
+            # protocol state (idempotence guard, arrival counter) advances at
+            # SUBMIT time; with a fold plane attached the arithmetic rides the
+            # chunk workers and lands at the next drain, in arrival order
+            self._fold_arrival(payload, weight)
             self.last_folded[index] = int(upload_version)
             self.arrivals += 1
             return True
@@ -91,6 +94,7 @@ class _AsyncTallyMixin:
         """Close the buffer window: divide the accumulator and reset the
         arrival counter. The caller (server manager) bumps the version."""
         with self._lock:
+            self._drain_locked()
             self.arrivals = 0
             return self._finish()
 

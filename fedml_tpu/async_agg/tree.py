@@ -42,6 +42,7 @@ from fedml_tpu.algorithms.fedavg_distributed import (
     MyMessage,
     init_template,
 )
+from fedml_tpu.algorithms.fold_plane import FoldPlane, TierPartialFoldTask
 from fedml_tpu.async_agg.server import _AsyncTallyMixin
 from fedml_tpu.async_agg.staleness import make_staleness_fn, memoize_staleness
 from fedml_tpu.comm.managers import DistributedManager
@@ -148,6 +149,12 @@ class TierAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
         first-wins flag — only the emission that closes the child's window
         counts toward the round barrier."""
         with self._lock:
+            # child partials fold inline (they are already f64 sums, one
+            # add apiece); with a fold plane attached, drain first so a
+            # mixed schedule of plane-queued and inline folds still applies
+            # in arrival order
+            self._drain_locked()
+            self._fold_epoch += 1
             flags = self.flag_client_model_uploaded_dict
             if index not in flags:
                 return False
@@ -182,6 +189,21 @@ class TierAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
         consistent). ``scale == 1.0`` skips the multiply entirely — the
         fresh path stays bit-identical to the sync tree's fold."""
         with self._lock:
+            self._fold_epoch += 1
+            if self._plane is not None:
+                task = TierPartialFoldTask(payload, float(weight_sum),
+                                           float(scale))
+                if self._acc is None:
+                    # the task ASSIGNS its first copy chunk-by-chunk (the
+                    # serial copy-not-add discipline); the zeros are only a
+                    # target buffer and are fully overwritten
+                    self._acc = np.zeros(task.acc_elems, np.float64)
+                    self._acc_provisional = True
+                    task.first = True
+                self._pending_finalize.append(task)
+                self._plane.submit(task, self._acc)
+                self.arrivals += 1
+                return
             part = np.ascontiguousarray(payload).view(np.float64)
             if scale != 1.0:
                 part = part * np.float64(scale)
@@ -199,6 +221,8 @@ class TierAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
         array (DP noise is added in place before framing). The first-wins
         flags are untouched — async windows never use them."""
         with self._lock:
+            self._drain_locked()
+            self._fold_epoch += 1
             if self._acc is None:
                 raise self._empty_round_error()
             acc = np.ascontiguousarray(self._acc)
@@ -221,6 +245,8 @@ class TierAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
         """Export the raw tally for the parent tier — (f64 accumulator as a
         byte view, weight sum, folds) — and reset for the next round."""
         with self._lock:
+            self._drain_locked()
+            self._fold_epoch += 1
             flags = self.flag_client_model_uploaded_dict
             if self._acc is None:
                 raise self._empty_round_error()
@@ -252,6 +278,12 @@ class TierAggregator(_AsyncTallyMixin, FedAvgDistAggregator):
         the number of folds lost so the caller can account for them; mixing
         them into the next round's partial would silently corrupt it."""
         with self._lock:
+            # drain rather than just dropping the pending tasks: a chunk
+            # worker may be mid-fold into the accumulator we are about to
+            # release, and an undrained task would otherwise finalize its
+            # weight into the NEXT window's tally
+            self._drain_locked()
+            self._fold_epoch += 1
             flags = self.flag_client_model_uploaded_dict
             # sync windows count set flags; async windows count arrivals
             # (fold_async/fold_partial_weighted never set flags) — the two
@@ -321,7 +353,8 @@ class EdgeAggregatorManager(DistributedManager):
                  leaf_base: int, leaf_total: int, client_num_in_total: int,
                  children_are_leaves: bool,
                  async_config: EdgeAsyncConfig | None = None,
-                 model_desc: str | None = None):
+                 model_desc: str | None = None,
+                 fold_workers: int = 0, fold_chunk: int | None = None):
         super().__init__(down_comm, rank=0, size=child_num + 1)
         self.up_comm = up_comm
         self.up_rank = up_rank
@@ -332,6 +365,12 @@ class EdgeAggregatorManager(DistributedManager):
         self.children_are_leaves = bool(children_are_leaves)
         self.aggregator = TierAggregator(
             child_num, tier_label=f"rank={up_rank} leaf_base={leaf_base}")
+        if fold_workers > 0:
+            # leaf uploads and barrier-free partials fold off this tier's
+            # receive threads, chunk-parallel (algorithms/fold_plane.py)
+            kw = {} if fold_chunk is None else {"chunk_elems": int(fold_chunk)}
+            self.aggregator.attach_fold_plane(FoldPlane(int(fold_workers),
+                                                        **kw))
         self._async = async_config
         if async_config is not None:
             self._buffer_goal = min(
@@ -438,6 +477,7 @@ class EdgeAggregatorManager(DistributedManager):
         self.comm.handle_receive_message()  # down fabric, caller thread
 
     def finish(self) -> None:
+        self.aggregator.close_fold_plane()
         self.comm.stop_receive_message()
         self.up_comm.stop_receive_message()
 
@@ -1375,6 +1415,8 @@ def run_tree_fedavg(
     tier_stats: dict | None = None,
     trace_lanes: str | None = None,
     trace_wire: bool = False,
+    tier_fold_workers: int = 0,
+    tier_fold_chunk: int | None = None,
 ):
     """End-to-end hierarchical FedAvg: root -> edge tiers -> leaf clients,
     one comm group (fabric) per parent/children cell. ``make_group_comm
@@ -1411,6 +1453,11 @@ def run_tree_fedavg(
     ``trace_wire`` on every cell comm so contexts propagate across the
     tiers (docs/OBSERVABILITY.md "Cross-rank causal tracing"); setting
     ``trace_wire`` alone stamps contexts without installing tracers.
+    ``tier_fold_workers`` > 0 attaches a sharded fold plane
+    (:mod:`fedml_tpu.algorithms.fold_plane`) to EVERY edge tier's tally —
+    chunk-parallel, bit-identical folding off the tier receive threads —
+    with ``tier_fold_chunk`` elements per chunk; the ROOT takes the same
+    knobs through ``server_kwargs`` (``fold_workers`` / ``fold_chunk``).
     Returns the final global variables (the flat server's return shape)."""
     topo = topology if isinstance(topology, TreeTopology) else TreeTopology(tuple(topology))
     if isinstance(tier_uplink_codec, str):
@@ -1528,6 +1575,7 @@ def run_tree_fedavg(
             client_num_in_total=train_data.num_clients,
             children_are_leaves=is_leaf_tier,
             async_config=async_cfg, model_desc=desc,
+            fold_workers=tier_fold_workers, fold_chunk=tier_fold_chunk,
         )
         if retry_policy is not None:
             # same attachment point as the flat runner: the retry policy

@@ -28,6 +28,7 @@ import numpy as np
 from fedml_tpu.algorithms.base import Aggregator, fedavg_aggregator
 from fedml_tpu.compress import error_feedback as ef
 from fedml_tpu.compress.codec import Codec, EncodedUpdate, tree_bytes
+from fedml_tpu.core.tree import tree_leaves_with_paths
 from fedml_tpu.obs import metrics as metricslib
 from fedml_tpu.obs import trace
 
@@ -118,8 +119,6 @@ def compressed_aggregator(
 
 
 def _flat_leaves(tree: Pytree) -> list[np.ndarray]:
-    from fedml_tpu.core.tree import tree_leaves_with_paths
-
     return [np.ravel(np.asarray(v)) for _, v in tree_leaves_with_paths(tree)]
 
 
@@ -155,6 +154,74 @@ def accumulate_encoded(
         for leaf in dense:
             acc[off : off + leaf.size] += weight * leaf.astype(np.float64)
             off += leaf.size
+
+
+# ---------------------------------------------------------------------------
+# Chunked accumulation for the sharded fold plane (algorithms/fold_plane.py)
+# ---------------------------------------------------------------------------
+
+
+def prepare_encoded(enc: EncodedUpdate, weight: float, codec: Codec):
+    """One-shot per-upload prep for chunk-partitioned folding: everything
+    :func:`accumulate_encoded` computes once per upload (the decode, the
+    global index plane) moves here so :func:`fold_encoded_slice` can apply
+    any ``[lo, hi)`` slice of the contribution independently — off the comm
+    receive thread, one chunk worker at a time — with the exact arithmetic
+    of the serial fold.
+
+    Top-k planes sort their global (leaf-offset) indices once; dense-plane
+    schemes decode once into a single transient f64 vector. Both carry the
+    same per-element contribution expression as the serial path
+    (``weight * value.astype(np.float64)``), so a chunked apply is
+    bit-identical to :func:`accumulate_encoded` over the full vector."""
+    with trace.span("compress/accumulate", scheme=enc.scheme):
+        if enc.scheme == "topk" and not isinstance(
+            enc.planes.get("values"), EncodedUpdate
+        ):
+            vals = _flat_leaves(enc.planes["values"])
+            idxs = _flat_leaves(enc.planes["indices"])
+            gidx_parts, contrib_parts = [], []
+            off = 0
+            for v, idx, spec in zip(vals, idxs, enc.meta_dict()["leaves"]):
+                n = int(np.prod(spec["shape"])) if spec["shape"] else 1
+                gidx_parts.append(off + idx.astype(np.int64))
+                contrib_parts.append(weight * v.astype(np.float64))
+                off += n
+            gidx = (np.concatenate(gidx_parts) if gidx_parts
+                    else np.zeros(0, np.int64))
+            contrib = (np.concatenate(contrib_parts) if contrib_parts
+                       else np.zeros(0, np.float64))
+            order = np.argsort(gidx, kind="stable")
+            return ("topk", gidx[order], contrib[order])
+        with trace.span("compress/decode", scheme=enc.scheme):
+            dense = _flat_leaves(codec.decode(enc))
+        full = (np.concatenate([leaf.astype(np.float64) for leaf in dense])
+                if dense else np.zeros(0, np.float64))
+        return ("dense", float(weight), full)
+
+
+def fold_encoded_slice(acc: np.ndarray, prep, lo: int, hi: int) -> None:
+    """Apply the ``[lo, hi)`` slice of a prepared upload to ``acc``.
+
+    Top-k slices scatter through a bincount over the chunk's index
+    partition (replacing the serial ``np.add.at`` — same sums, since top-k
+    indices are unique per leaf and leaves occupy disjoint offset ranges,
+    so every element receives at most one contribution; untouched elements
+    add an exact ``+0.0``, and the accumulator can never hold ``-0.0``
+    because it starts at ``+0.0`` and an IEEE sum is ``-0`` only when both
+    operands are). Dense slices re-apply the serial per-element expression
+    ``weight * full64[j]`` verbatim."""
+    kind = prep[0]
+    if kind == "topk":
+        _, sidx, scontrib = prep
+        a, b = np.searchsorted(sidx, (lo, hi))
+        if a == b:
+            return
+        acc[lo:hi] += np.bincount(sidx[a:b] - lo, weights=scontrib[a:b],
+                                  minlength=hi - lo)
+    else:
+        _, weight, full = prep
+        acc[lo:hi] += weight * full[lo:hi]
 
 
 # ---------------------------------------------------------------------------

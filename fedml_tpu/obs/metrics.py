@@ -106,6 +106,16 @@ JOB_SCHED_TURNS = "Job/SchedulerTurns"
 JOB_ROUNDS = "Job/Rounds"
 JOB_ERRORS = "Job/Errors"
 
+# Sharded fold plane keys (algorithms/fold_plane.py, docs/PERFORMANCE.md
+# "The server fold plane"): QueueDepth is the gauge of uploads submitted to
+# the chunk workers and not yet fully folded (sampled at each enqueue, after
+# the plane condition is released); StallMs is the histogram of wall time a
+# quiesce point (aggregate / emit / snapshot / export) spent draining the
+# queues — how much fold debt the barrier actually paid. Rendered by
+# tools/fleet_report.py from the run's registry snapshot.
+FOLD_QUEUE_DEPTH = "Fold/QueueDepth"
+FOLD_STALL_MS = "Fold/StallMs"
+
 
 class CommBytesAccountant:
     """Per-round uplink/downlink byte ledger for the message-passing path.

@@ -535,8 +535,9 @@ def test_shard_smoke_tool_runs():
     assert mod.main([]) == 0
 
 
+@pytest.mark.slow  # ~136s: the --packed arm recompiles packed-x-sharded round programs for two mesh geometries; the packed-x-sharded bit-identity it asserts stays tier-1 via test_packed_tp_round_allclose / test_fsdp_sharded_round_bit_identical
 def test_shard_smoke_packed_arm():
-    """The tier-1 packed x sharded bit-identity guard: tools/shard_smoke.py
+    """The packed x sharded bit-identity guard: tools/shard_smoke.py
     --packed in-process — packed lanes on the (2, 2) fsdp mesh and on the
     (1, 4) single-client-shard geometry, each bit-identical to the same
     pack_lanes on an unsharded client mesh."""

@@ -80,6 +80,34 @@ def load_fleet(path: str | Path) -> tuple[dict, int]:
     return validate_record(obj), 1 if obj.get("round") is not None else 0
 
 
+def load_process_registry(path: str | Path) -> dict | None:
+    """The run's process MetricRegistry snapshot, when the file carries one
+    (the ``registry`` key main_fedavg writes alongside fleet.json totals).
+    JSONL per-round files carry per-rank state only — returns None."""
+    try:
+        obj = json.loads(Path(path).read_text())
+    except json.JSONDecodeError:
+        return None
+    reg = obj.get("registry") if isinstance(obj, dict) else None
+    return reg if isinstance(reg, dict) else None
+
+
+def attach_fold_plane(report: dict, reg: dict | None) -> dict:
+    """Join the server fold plane's series (algorithms/fold_plane.py) into
+    the report: the enqueue-time queue depth gauge and the quiesce stall
+    histogram — "did the plane keep up, and what did drains cost"."""
+    from fedml_tpu.obs import metrics as metricslib
+
+    if not reg:
+        return report
+    depth = (reg.get("gauges") or {}).get(metricslib.FOLD_QUEUE_DEPTH)
+    stall = (reg.get("histograms") or {}).get(metricslib.FOLD_STALL_MS)
+    if depth is None and stall is None:
+        return report
+    report["fold"] = {"queue_depth": depth, "stall_ms": stall}
+    return report
+
+
 def _hist(snap: dict | None):
     from fedml_tpu.obs.registry import Histogram
 
@@ -273,6 +301,15 @@ def format_text(report: dict) -> str:
                 f"{r['close_ms']:>9g} {_na(r['gating_rank']):>11} "
                 f"{leg:<22} {r['gating_ms']:>9g}"
             )
+    fold = report.get("fold")
+    if fold:
+        lines += ["", "server fold plane (chunk-parallel aggregation — "
+                      "algorithms/fold_plane.py):"]
+        if fold.get("queue_depth") is not None:
+            lines.append("  queue depth at last enqueue: "
+                         f"{fold['queue_depth']:g}")
+        lines += _render_histogram("fold stall ms (quiesce drain wall time)",
+                                   fold.get("stall_ms"))
     for name in FLEET_HISTOGRAMS:
         lines += _render_histogram(name, report["histograms"].get(name))
     if report["timelines"]:
@@ -302,6 +339,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     view, rounds = load_fleet(args.fleet)
     report = summarize(view, rounds)
+    attach_fold_plane(report, load_process_registry(args.fleet))
     if args.trace is not None:
         attach_critical_paths(report, args.trace)
     if args.format == "json":

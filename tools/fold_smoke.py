@@ -1,0 +1,151 @@
+"""Sharded fold plane smoke: plane-on must equal plane-off BIT-FOR-BIT
+(docs/PERFORMANCE.md "The server fold plane"), per round and final, on the
+loopback fabric with a rank-ordered uplink so both arms fold the same
+arrival sequence:
+
+- **flat dense** — the base streaming server, ``fold_workers=2`` against
+  the serial fold.
+- **robust (clip + DP)** — the streaming mean defense: the plane runs the
+  norm/clip decision per upload off the receive thread, the seeded noise
+  still lands at close.
+- **q8-encoded uplink** — the decode moves into the chunk workers'
+  memoized prepare; scatter arithmetic unchanged.
+- **async (full buffer)** — fold-on-arrival with the plane under the
+  barrier-free window; drains at every emission.
+- **(1, 4) tree** — a fold plane on the edge tier's tally AND the root's
+  partial fold (``tier_fold_workers`` + root ``fold_workers``).
+
+The chunk size is forced far below the model size so every upload really
+spans multiple chunks per worker — the grid, not a degenerate one-chunk
+pass, is what the identity is certified over.
+
+    JAX_PLATFORMS=cpu python tools/fold_smoke.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUNDS = 2
+WORKERS = 4
+FOLD_WORKERS = 2
+FOLD_CHUNK = 7  # elements — tiny on purpose: many chunks per worker
+
+
+def main(argv=None) -> int:
+    import jax
+    import numpy as np
+    import optax
+
+    from fedml_tpu.algorithms.fedavg_distributed import (
+        MyMessage,
+        run_distributed_fedavg,
+    )
+    from fedml_tpu.algorithms.robust_distributed import RobustDistConfig
+    from fedml_tpu.async_agg.tree import run_tree_fedavg
+    from fedml_tpu.comm.loopback import LoopbackCommManager, OrderedUplinkFabric
+    from fedml_tpu.compress.codec import make_codec
+    from fedml_tpu.core.trainer import ClientTrainer
+    from fedml_tpu.data.synthetic import gaussian_blobs
+    from fedml_tpu.models.linear import LogisticRegression
+
+    train, _ = gaussian_blobs(
+        n_clients=WORKERS, samples_per_client=24, num_classes=4, seed=11
+    )
+    trainer = ClientTrainer(
+        module=LogisticRegression(num_classes=4),
+        optimizer=optax.sgd(0.2), epochs=1,
+    )
+
+    def snap(v):
+        return [np.asarray(l).copy() for l in jax.tree.leaves(v)]
+
+    def run_flat(**kwargs):
+        fabric = OrderedUplinkFabric(
+            WORKERS + 1, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+        )
+        per_round = []
+        final = run_distributed_fedavg(
+            trainer, train, worker_num=WORKERS, round_num=ROUNDS,
+            batch_size=8,
+            make_comm=lambda r: LoopbackCommManager(fabric, r),
+            on_round_done=lambda r, v: per_round.append((r, snap(v))),
+            **kwargs,
+        )
+        return snap(final), per_round
+
+    def run_tree(**kwargs):
+        def make_group(path, world):
+            if path == ():
+                from fedml_tpu.comm.loopback import LoopbackFabric
+
+                fabric = LoopbackFabric(world)
+            else:
+                fabric = OrderedUplinkFabric(
+                    world, WORKERS, MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER
+                )
+            return lambda r: LoopbackCommManager(fabric, r)
+
+        per_round = []
+        final = run_tree_fedavg(
+            trainer, train, (1, WORKERS), ROUNDS, 8,
+            on_round_done=lambda r, v: per_round.append((r, snap(v))),
+            make_group_comm=make_group,
+            **kwargs,
+        )
+        return snap(final), per_round
+
+    plane = {"fold_workers": FOLD_WORKERS, "fold_chunk": FOLD_CHUNK}
+
+    def assert_identical(off, on, arm: str):
+        off_final, off_rounds = off
+        on_final, on_rounds = on
+        assert len(on_rounds) == len(off_rounds) == ROUNDS, (
+            arm, len(on_rounds), len(off_rounds)
+        )
+        for (ra, leaves_a), (rs, leaves_s) in zip(on_rounds, off_rounds):
+            assert ra == rs, (arm, ra, rs)
+            for a, b in zip(leaves_a, leaves_s):
+                np.testing.assert_array_equal(
+                    a, b, err_msg=f"round {ra}: {arm} plane-on != plane-off"
+                )
+        for a, b in zip(on_final, off_final):
+            np.testing.assert_array_equal(
+                a, b, err_msg=f"final: {arm} plane-on != plane-off"
+            )
+
+    assert_identical(run_flat(), run_flat(**plane), "flat dense")
+
+    robust = dict(robust_config=RobustDistConfig(
+        rule="mean", norm_bound=0.05, dp_stddev=1e-3, dp_seed=3))
+    assert_identical(run_flat(**robust), run_flat(**robust, **plane),
+                     "robust mean (clip + DP)")
+
+    q8 = dict(codec=make_codec("q8"))
+    assert_identical(run_flat(**q8), run_flat(**q8, **plane), "q8 uplink")
+
+    asy = dict(server_mode="async", buffer_goal=WORKERS,
+               staleness_weight="const")
+    assert_identical(run_flat(**asy), run_flat(**asy, **plane),
+                     "async (full buffer)")
+
+    tplane = {"tier_fold_workers": FOLD_WORKERS,
+              "tier_fold_chunk": FOLD_CHUNK,
+              "server_kwargs": plane}
+    assert_identical(run_tree(), run_tree(**tplane), "(1, 4) tree")
+
+    print(
+        f"fold smoke OK: {ROUNDS} rounds x {WORKERS} workers — plane-on "
+        f"({FOLD_WORKERS} workers, {FOLD_CHUNK}-element chunks) == plane-off "
+        "bit-for-bit on flat, robust(clip+DP), q8-encoded, async(full "
+        "buffer), and (1,4)-tree arms"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
